@@ -1,0 +1,234 @@
+"""Top-level user API: ``init``, ``shutdown``, ``@parallelize``, ``grad``.
+
+Analog of ref ``alpa/api.py`` (SURVEY.md §2.1).  The decorator keeps the
+reference's argument semantics — ``static_argnums``/``donate_argnums``
+("auto" supported), ``batch_argnums`` marking data-batch args for microbatch
+splitting and batch-dim sharding — and dispatches compilation to a
+``ParallelMethod`` with per-(tree, avals, statics) executable caching
+(ref api.py:209 ``_compile_parallel_executable`` lu.cache).
+"""
+import functools
+import logging
+from typing import Any, Callable, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.api_util import shaped_abstractify
+from jax.tree_util import (keystr, tree_flatten, tree_flatten_with_path,
+                           tree_unflatten)
+
+from alpa_tpu.device_mesh import (init_global_cluster,
+                                  shutdown_global_cluster)
+from alpa_tpu.parallel_method import ParallelMethod, ShardParallel
+from alpa_tpu.pipeline_parallel.primitive_def import mark_gradient
+
+logger = logging.getLogger(__name__)
+
+unsafe_are_we_inside_parallelize = False
+
+
+def init(cluster: str = "local",
+         devices: Optional[Sequence] = None,
+         num_nodes: Optional[int] = None,
+         num_devices_per_node: Optional[int] = None):
+    """Initialize the device cluster (ref api.py:25).
+
+    ``cluster='local'``: this process's devices (TPU chips of one host or the
+    whole single-controller pod view).  ``cluster='distributed'``: call
+    ``jax.distributed.initialize`` first for multi-host pods.
+    """
+    init_global_cluster(cluster, devices, num_nodes, num_devices_per_node)
+
+
+def shutdown():
+    """Release cluster state (ref api.py:59)."""
+    shutdown_global_cluster()
+
+
+def _is_static_arg(arg) -> bool:
+    leaves, _ = tree_flatten(arg)
+    if not leaves:
+        return True
+    return not any(
+        isinstance(x, (jax.Array, np.ndarray, float, int, complex, bool)) or
+        hasattr(x, "aval") for x in leaves)
+
+
+def _abstractify(x):
+    if hasattr(x, "aval"):
+        a = x.aval
+        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+    x = np.asarray(x)
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+class ParallelizedFunc:
+    """The callable returned by ``@parallelize`` (ref api.py:106)."""
+
+    def __init__(self,
+                 fun: Callable,
+                 method: Optional[ParallelMethod],
+                 static_argnums: Union[str, Sequence[int]] = "auto",
+                 donate_argnums: Union[str, Sequence[int]] = "auto",
+                 batch_argnums: Sequence[int] = (1,)):
+        functools.update_wrapper(self, fun)
+        self.fun = fun
+        self.method = method or ShardParallel()
+        self.static_argnums = static_argnums
+        self.donate_argnums = donate_argnums
+        self.batch_argnums = tuple(batch_argnums)
+        self._executable_cache = {}
+        self._last_executable = None
+
+    # ---- compilation ----
+    def _decode_args(self, args):
+        """Split static/dynamic args, flatten, build metadata."""
+        if self.static_argnums == "auto":
+            static_idx = tuple(
+                i for i, a in enumerate(args) if _is_static_arg(a))
+        else:
+            static_idx = tuple(self.static_argnums)
+        dyn_idx = tuple(i for i in range(len(args)) if i not in static_idx)
+        static_vals = tuple(args[i] for i in static_idx)
+        dyn_args = tuple(args[i] for i in dyn_idx)
+
+        path_leaves, in_tree = tree_flatten_with_path(dyn_args)
+        in_paths = tuple(keystr(p) for p, _ in path_leaves)
+        flat_args = [x for _, x in path_leaves]
+        avals = tuple(_abstractify(x) for x in flat_args)
+
+        # flat flags: does this leaf belong to a batch argument?
+        batch_set = set(self.batch_argnums)
+        batch_invars = []
+        for (path, _x) in path_leaves:
+            top = path[0].idx  # index into dyn_args tuple
+            orig_idx = dyn_idx[top]
+            batch_invars.append(orig_idx in batch_set)
+
+        return (static_idx, static_vals, dyn_idx, flat_args, in_tree,
+                in_paths, avals, tuple(batch_invars))
+
+    def _infer_donation(self, flat_fun, avals, batch_invars):
+        """donate_argnums='auto': donate non-batch inputs whose (shape,dtype)
+        matches an unclaimed output leaf (i.e. state flowing to new state)."""
+        out_shapes = jax.eval_shape(flat_fun, *avals)
+        # Cache on the fun so compile paths don't re-trace (see
+        # compile_shard_executable's _pin_state_out_shardings).
+        flat_fun.out_shapes = out_shapes
+        pool = {}
+        for o in tree_flatten(out_shapes)[0]:
+            pool[(tuple(o.shape), np.dtype(o.dtype))] = pool.get(
+                (tuple(o.shape), np.dtype(o.dtype)), 0) + 1
+        donated = []
+        for aval, is_batch in zip(avals, batch_invars):
+            key = (tuple(aval.shape), np.dtype(aval.dtype))
+            if not is_batch and pool.get(key, 0) > 0:
+                pool[key] -= 1
+                donated.append(True)
+            else:
+                donated.append(False)
+        return tuple(donated)
+
+    def get_executable(self, *args):
+        (static_idx, static_vals, dyn_idx, flat_args, in_tree, in_paths,
+         avals, batch_invars) = self._decode_args(args)
+        key = (in_tree, avals, static_idx, static_vals, batch_invars)
+        try:
+            cached = self._executable_cache.get(key)
+        except TypeError:  # unhashable static arg
+            key = None
+            cached = None
+        if cached is not None:
+            self._last_executable = cached
+            return cached, flat_args
+
+        out_tree_store = [None]
+        fun = self.fun
+        arg_count = len(args)
+
+        def flat_fun(*flat):
+            dyn = tree_unflatten(in_tree, list(flat))
+            full = []
+            di = iter(dyn)
+            si = iter(static_vals)
+            for i in range(arg_count):
+                full.append(next(si) if i in static_idx else next(di))
+            out = fun(*full)
+            flat_out, out_tree = tree_flatten(out)
+            out_tree_store[0] = out_tree
+            return flat_out
+
+        if self.donate_argnums == "auto":
+            donated_invars = self._infer_donation(flat_fun, avals,
+                                                  batch_invars)
+        else:
+            donate_set = set(self.donate_argnums)
+            donated_invars = tuple(
+                dyn_idx[p[0].idx] in donate_set
+                for p, _ in tree_flatten_with_path(
+                    tree_unflatten(in_tree, list(avals)))[0])
+
+        executable = self.method.compile_executable(flat_fun, avals, in_tree,
+                                                    in_paths, donated_invars,
+                                                    batch_invars)
+        if out_tree_store[0] is None:
+            # method didn't trace eagerly; force one abstract eval
+            jax.eval_shape(flat_fun, *avals)
+        executable.out_tree = out_tree_store[0]
+        if key is not None:
+            self._executable_cache[key] = executable
+        self._last_executable = executable
+        return executable, flat_args
+
+    def __call__(self, *args):
+        executable, flat_args = self.get_executable(*args)
+        flat_out = executable.launch_on_driver(*flat_args)
+        return tree_unflatten(executable.out_tree, list(flat_out))
+
+    def get_last_executable(self):
+        return self._last_executable
+
+
+def parallelize(fun: Optional[Callable] = None,
+                *,
+                method: Optional[ParallelMethod] = None,
+                static_argnums: Union[str, Sequence[int]] = "auto",
+                donate_argnums: Union[str, Sequence[int]] = "auto",
+                batch_argnums: Sequence[int] = (1,)):
+    """Parallelize a single-device jax function (ref api.py:71)."""
+
+    def decorate(f):
+        return ParallelizedFunc(f, method, static_argnums, donate_argnums,
+                                batch_argnums)
+
+    if fun is None:
+        return decorate
+    return decorate(fun)
+
+
+def grad(fun, *args, **kwargs):
+    """``jax.grad`` + gradient boundary marker (ref api.py:241).
+
+    Use this instead of ``jax.grad`` inside parallelized functions so that
+    gradient accumulation and pipeline compilation can split compute_grad
+    from apply_grad at the marker.
+    """
+    jax_grad = jax.grad(fun, *args, **kwargs)
+
+    @functools.wraps(jax_grad)
+    def wrapped(*call_args, **call_kwargs):
+        return mark_gradient(jax_grad(*call_args, **call_kwargs))
+
+    return wrapped
+
+
+def value_and_grad(fun, *args, **kwargs):
+    """``jax.value_and_grad`` + gradient marker (ref api.py:265)."""
+    jax_vg = jax.value_and_grad(fun, *args, **kwargs)
+
+    @functools.wraps(jax_vg)
+    def wrapped(*call_args, **call_kwargs):
+        val, grads = jax_vg(*call_args, **call_kwargs)
+        return mark_gradient((val, grads))
+
+    return wrapped

@@ -1,0 +1,88 @@
+"""Global configuration flags for alpa_tpu.
+
+TPU-native analog of the reference's ``alpa/global_env.py:5-139`` GlobalConfig
+singleton.  Unlike the reference there is no driver->Ray-worker snapshot sync
+(``update_worker_config``): under jax.distributed every host process reads the
+same environment, so flags are plain process-local state seeded from env vars.
+"""
+import os
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.lower() in ("1", "true", "yes", "on")
+
+
+class GlobalConfig:
+    """Process-global configuration object.
+
+    Mirrors the flag surface of the reference GlobalConfig where the concept
+    survives the TPU redesign; NCCL/Ray/cupy flags are intentionally absent.
+    """
+
+    def __init__(self):
+        # ---------- backend ----------
+        # "tpu" | "cpu" | "gpu".  Used to pick the jax platform for meshes.
+        self.backend = os.environ.get("ALPA_TPU_BACKEND", None)  # None = jax default
+        # Treated like the reference's has_cuda: whether real accelerators exist.
+        self.debug_single_device = _env_bool("ALPA_TPU_DEBUG_SINGLE_DEVICE", False)
+
+        # ---------- compilation ----------
+        # Print compilation phase timings (ref: debug_compilation_time).
+        self.print_compilation_time = _env_bool("ALPA_TPU_PRINT_COMPILATION_TIME", False)
+        # Dump intermediate jaxprs / HLO to this dir if set.
+        self.dump_debug_info_dir = os.environ.get("ALPA_TPU_DUMP_DIR", None)
+        # Use static cost model instead of on-device profiling for auto stage
+        # construction (ref: HloCostModelProfileWorker path).  Default True on
+        # TPU: spinning up submeshes to profile is slow (SURVEY.md hard part 2).
+        self.use_hlo_cost_model = _env_bool("ALPA_TPU_USE_HLO_COST_MODEL", True)
+        # Path to a pickled ProfilingResultDatabase.
+        self.profiling_database_filename = os.environ.get(
+            "ALPA_TPU_PROF_DATABASE", None)
+        # Time limit (seconds) handed to the ILP solver.
+        self.ilp_time_limit = int(os.environ.get("ALPA_TPU_ILP_TIME_LIMIT", "600"))
+        # Seed used for deterministic compilation decisions.
+        self.compile_seed = int(os.environ.get("ALPA_TPU_COMPILE_SEED", "42"))
+
+        # ---------- runtime ----------
+        # Cross-mesh resharding strategy: "send_recv" | "broadcast".
+        # (ref: global_config.resharding_mode)
+        self.resharding_mode = os.environ.get("ALPA_TPU_RESHARDING_MODE", "send_recv")
+        # Load-balancing mode for resharding send selection:
+        # "normal" | "no_loadbalance".
+        self.resharding_loadbalance_mode = os.environ.get(
+            "ALPA_TPU_RESHARDING_LOADBALANCE", "normal")
+        # Collect timing trace events on the instruction interpreter hot loop.
+        self.collect_trace = _env_bool("ALPA_TPU_COLLECT_TRACE", False)
+        # Use dummy data for benchmarking (skip real input transfer).
+        self.use_dummy_value_for_benchmarking = _env_bool(
+            "ALPA_TPU_DUMMY_VALUES", False)
+        # Shard the apply_grad computation over the pipeline meshes instead of
+        # replicating (ref: grad accumulation + apply grad placement).
+        self.pipeline_distributed_apply_grad = True
+        # Whether pipeshard runtime overlaps resharding with compute by
+        # issuing transfers as soon as producers finish (async dispatch).
+        self.overlap_resharding = True
+
+        # ---------- checkpointing ----------
+        # Local cache dir drained asynchronously to the shared FS
+        # (ref: DaemonMoveWorker).
+        self.checkpoint_cache_dir = os.environ.get("ALPA_TPU_CKPT_CACHE", None)
+
+        # ---------- testing ----------
+        # Replace heavy compile paths with fast ones in unit tests.
+        self.testing_mode = _env_bool("ALPA_TPU_TESTING", False)
+
+    def show(self):
+        return {k: v for k, v in self.__dict__.items()}
+
+
+global_config = GlobalConfig()
+
+# Flags appended to XLA_FLAGS at import, mirroring the reference's
+# global_env.py:144-146.  Kept minimal: libtpu picks good defaults.
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_tpu_spmd_threshold_for_allgather_cse" not in _xla_flags:
+    pass  # placeholder: no forced flags; users own XLA_FLAGS.

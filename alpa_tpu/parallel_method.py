@@ -1,0 +1,196 @@
+"""Parallelization strategy objects.
+
+Analog of ref ``alpa/parallel_method.py`` (SURVEY.md §2.1): a
+``ParallelMethod`` owns compilation — it turns a traced function plus a mesh
+into an executable.  The strategy surface is kept:
+``ShardParallel``/``DataParallel``/``Zero2Parallel``/``Zero3Parallel``/
+``PipeshardParallel``/``LocalPipelineParallel`` plus
+``get_3d_parallel_method`` for manual DP x TP x PP.
+"""
+import logging
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from alpa_tpu.device_mesh import (LocalPhysicalDeviceMesh, PhysicalDeviceMesh,
+                                  VirtualPhysicalMesh,
+                                  get_global_physical_mesh,
+                                  get_global_virtual_physical_mesh)
+from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+from alpa_tpu.shard_parallel.manual_sharding import ManualShardingOption
+
+logger = logging.getLogger(__name__)
+
+
+class ParallelMethod:
+    """Base class (ref parallel_method.py:46)."""
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        raise NotImplementedError
+
+
+class ShardParallel(ParallelMethod):
+    """Intra-op only: shard every operator over one device mesh, optionally
+    with gradient accumulation (ref parallel_method.py:64)."""
+
+    def __init__(self,
+                 devices: Optional[Union[PhysicalDeviceMesh, Sequence]] = None,
+                 num_micro_batches: Optional[int] = None,
+                 auto_sharding_option: Optional[AutoShardingOption] = None,
+                 manual_sharding_option: Optional[ManualShardingOption] = None):
+        if devices is not None and not isinstance(devices, PhysicalDeviceMesh):
+            devices = LocalPhysicalDeviceMesh(list(devices))
+        self.devices = devices
+        self.num_micro_batches = num_micro_batches
+        self.as_option = auto_sharding_option or AutoShardingOption()
+        self.ms_option = manual_sharding_option
+
+    def _get_mesh(self) -> PhysicalDeviceMesh:
+        if self.devices is not None:
+            return self.devices
+        mesh = get_global_physical_mesh(create_if_not_exist=True)
+        return mesh
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        from alpa_tpu.shard_parallel.compile_executable import (
+            compile_shard_executable)
+        return compile_shard_executable(fun, self._get_mesh(), in_avals,
+                                        in_tree, in_paths, donated_invars,
+                                        batch_invars, self.num_micro_batches,
+                                        self.as_option, self.ms_option)
+
+
+class DataParallel(ShardParallel):
+    """Pure batch-dim data parallelism (ref parallel_method.py:115)."""
+
+    def __init__(self, devices=None, num_micro_batches=None):
+        super().__init__(
+            devices, num_micro_batches,
+            AutoShardingOption(enable_auto_sharding=False,
+                               force_data_parallel=True,
+                               force_batch_dim_to_mesh_dim=0))
+
+
+class Zero2Parallel(ShardParallel):
+    """DP + sharded optimizer state / reduce-scattered grads
+    (ref parallel_method.py:130)."""
+
+    def __init__(self, devices=None, num_micro_batches=None):
+        super().__init__(
+            devices, num_micro_batches,
+            AutoShardingOption(enable_auto_sharding=False,
+                               force_data_parallel=True,
+                               prefer_reduce_scatter=True))
+
+
+class Zero3Parallel(ShardParallel):
+    """DP + sharded params/grads/optimizer state (ref parallel_method.py:146)."""
+
+    def __init__(self, devices=None, num_micro_batches=None):
+        super().__init__(
+            devices, num_micro_batches,
+            AutoShardingOption(enable_auto_sharding=False,
+                               force_data_parallel=True,
+                               prefer_reduce_scatter=True,
+                               force_zero_stage_3=True))
+
+
+class PipeshardParallel(ParallelMethod):
+    """Inter-op (pipeline) + intra-op parallelism — the flagship method
+    (ref parallel_method.py:160, compile path SURVEY.md §3.3)."""
+
+    def __init__(self,
+                 devices: Optional[VirtualPhysicalMesh] = None,
+                 num_micro_batches: int = 1,
+                 default_auto_sharding_option: Optional[AutoShardingOption] = None,
+                 pipeline_schedule: str = "1f1b",
+                 layer_option: Optional[Any] = None,
+                 stage_option: Optional[Any] = None,
+                 stage_input_shardings=None):
+        self.devices = devices
+        self.num_micro_batches = num_micro_batches
+        self.as_option = default_auto_sharding_option or AutoShardingOption()
+        self.pipeline_schedule = pipeline_schedule
+        self.layer_option = layer_option
+        self.stage_option = stage_option
+        self.stage_input_shardings = stage_input_shardings
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        from alpa_tpu.pipeline_parallel.compile_executable import (
+            compile_pipeshard_executable)
+        mesh = self.devices or get_global_virtual_physical_mesh()
+        assert mesh is not None, (
+            "No virtual mesh: call alpa_tpu.init() first")
+        return compile_pipeshard_executable(
+            fun, mesh, in_avals, in_tree, in_paths, donated_invars,
+            batch_invars, self.num_micro_batches, self.as_option,
+            self.pipeline_schedule, self.layer_option, self.stage_option)
+
+
+class LocalPipelineParallel(ParallelMethod):
+    """Single-device pipeline interpreter for debugging
+    (ref parallel_method.py:317 / local_pipeline.py:16)."""
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        from alpa_tpu.pipeline_parallel.local_pipeline import (
+            compile_local_pipeline_executable)
+        return compile_local_pipeline_executable(fun, in_avals, in_tree)
+
+
+def get_3d_parallel_method(num_micro_batches: int,
+                           data_parallel: int,
+                           operator_parallel: int,
+                           pipeline_parallel: int,
+                           devices: Optional[VirtualPhysicalMesh] = None,
+                           allow_degenerate_into_shard_parallel: bool = True):
+    """Manual DP x TP x PP method (ref parallel_method.py:247).
+
+    Slices the cluster into ``pipeline_parallel`` equal submeshes and forces a
+    (dp, tp) logical mesh in each stage.
+    """
+    mesh = devices or get_global_virtual_physical_mesh()
+    assert mesh is not None
+    dp, op, pp = data_parallel, operator_parallel, pipeline_parallel
+    num_devices = mesh.num_devices
+    assert dp * op * pp == num_devices, (
+        f"dp({dp}) * op({op}) * pp({pp}) != #devices({num_devices})")
+
+    if pp == 1 and allow_degenerate_into_shard_parallel:
+        return ShardParallel(
+            num_micro_batches=num_micro_batches,
+            auto_sharding_option=AutoShardingOption(
+                enable_auto_sharding=False,
+                force_data_parallel=(op == 1),
+                logical_mesh_shape=(dp, op)))
+
+    from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+    from alpa_tpu.pipeline_parallel.stage_construction import ManualStageOption
+
+    # Build per-stage submesh shapes: pp stages, each dp*op devices.
+    devices_per_stage = dp * op
+    if devices_per_stage >= mesh.num_devices_per_host:
+        hosts_per_stage = devices_per_stage // mesh.num_devices_per_host
+        submesh = (hosts_per_stage, mesh.num_devices_per_host)
+    else:
+        submesh = (1, devices_per_stage)
+    submeshes = [list(submesh) for _ in range(pp)]
+    logical_shapes = [(dp, op) for _ in range(pp)]
+
+    return PipeshardParallel(
+        devices=mesh,
+        num_micro_batches=num_micro_batches,
+        default_auto_sharding_option=AutoShardingOption(
+            enable_auto_sharding=False,
+            force_data_parallel=(op == 1),
+            logical_mesh_shape=(dp, op)),
+        pipeline_schedule="1f1b",
+        layer_option=AutoLayerOption(layer_num=pp),
+        stage_option=ManualStageOption(
+            forward_stage_layer_ids=[[i] for i in range(pp)],
+            submesh_physical_shapes=submeshes,
+            submesh_logical_shapes=logical_shapes,
+            submesh_autosharding_option_dicts=[{} for _ in range(pp)]))

@@ -1,0 +1,7 @@
+"""Inter-operator (pipeline) parallelization.
+
+TPU-native analog of ref ``alpa/pipeline_parallel/`` (SURVEY.md §2.4): layer
+clustering, stage construction, static schedules, a single-controller
+multi-mesh pipeshard runtime, and cross-mesh resharding via the jax runtime
+instead of NCCL p2p.
+"""

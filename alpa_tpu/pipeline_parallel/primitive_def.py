@@ -1,0 +1,124 @@
+"""Pipeline/gradient marker primitive.
+
+Analog of ref ``alpa/pipeline_parallel/primitive_def.py``: a jax primitive
+``pipeline_p`` that is semantically the identity, used to tag
+
+* layer boundaries (``mark_pipeline_boundary``, ref :18) — hints consumed by
+  layer construction;
+* full layer extents (mark_type="start"/"end") wrapping every layer
+  input/output, inserted by layer construction so slicing survives jaxpr
+  transforms;
+* the gradient boundary (``mark_gradient``, ref :24) separating
+  compute_grad from apply_grad for gradient accumulation.
+
+Unlike the reference there is **no XLA CustomCall lowering**
+(ref primitive_def.py:68-121): all slicing happens at jaxpr level before
+lowering (SURVEY.md §7 design translations), so the mlir lowering is a
+no-op identity.  JVP/transpose rules keep markers alive through autodiff
+(ref :154): transposing a "start" marker yields an "end" marker of the
+backward layer and vice versa.
+"""
+import itertools
+from typing import Sequence
+
+import jax
+from jax.extend.core import Primitive
+from jax.interpreters import ad, batching, mlir
+from jax.tree_util import tree_flatten, tree_unflatten
+
+pipeline_p = Primitive("pipeline")
+pipeline_p.multiple_results = True
+
+
+def _pipeline_impl(*args, **_params):
+    return args
+
+
+def _pipeline_abstract_eval(*avals, **_params):
+    return avals
+
+
+pipeline_p.def_impl(_pipeline_impl)
+pipeline_p.def_abstract_eval(_pipeline_abstract_eval)
+
+
+def _pipeline_jvp(primals, tangents, **params):
+    primal_outs = pipeline_p.bind(*primals, **params)
+    nz_idx = [
+        i for i, t in enumerate(tangents) if not isinstance(t, ad.Zero)
+    ]
+    tangent_outs = list(tangents)
+    if nz_idx:
+        marked = pipeline_p.bind(
+            *[tangents[i] for i in nz_idx],
+            name=params["name"] + "_jvp",
+            mark_type=params["mark_type"])
+        for i, t in zip(nz_idx, marked):
+            tangent_outs[i] = t
+    return primal_outs, tangent_outs
+
+
+ad.primitive_jvps[pipeline_p] = _pipeline_jvp
+
+_FLIP = {"start": "end", "end": "start", "grad": "grad", "boundary": "boundary",
+         "jvp": "jvp"}
+
+
+def _pipeline_transpose(cts, *args, name, mark_type):
+    nz_idx = [i for i, ct in enumerate(cts) if not isinstance(ct, ad.Zero)]
+    out = list(cts)
+    if nz_idx:
+        marked = pipeline_p.bind(
+            *[cts[i] for i in nz_idx],
+            name=name + "_backward",
+            mark_type=_FLIP[mark_type])
+        for i, ct in zip(nz_idx, marked):
+            out[i] = ct
+    return out
+
+
+ad.primitive_transposes[pipeline_p] = _pipeline_transpose
+
+
+def _pipeline_batching(args, dims, **params):
+    return pipeline_p.bind(*args, **params), dims
+
+
+batching.primitive_batchers[pipeline_p] = _pipeline_batching
+
+# Identity lowering: markers vanish at HLO level.
+mlir.register_lowering(pipeline_p, lambda ctx, *args, **_params: args)
+
+_boundary_counter = itertools.count()
+
+
+def mark_pipeline_boundary():
+    """User-facing layer-boundary hint (ref primitive_def.py:18).
+
+    Call between layers inside a function parallelized with
+    ``ManualLayerOption``-style layer construction.
+    """
+    pipeline_p.bind(name=str(next(_boundary_counter)), mark_type="boundary")
+
+
+def mark_pipeline_values(values, name: str, mark_type: str):
+    """Wrap a pytree of values in a pipeline marker."""
+    flat, tree = tree_flatten(values)
+    if not flat:
+        return values
+    marked = pipeline_p.bind(*flat, name=name, mark_type=mark_type)
+    return tree_unflatten(tree, marked)
+
+
+def mark_gradient(grads):
+    """Tag gradient values as the compute/apply split point
+    (ref primitive_def.py:24)."""
+    return mark_pipeline_values(grads, "grad", "grad")
+
+
+def is_pipeline_eqn(eqn) -> bool:
+    return eqn.primitive is pipeline_p
+
+
+def is_marker(eqn, mark_type: str) -> bool:
+    return eqn.primitive is pipeline_p and eqn.params["mark_type"] == mark_type

@@ -1,0 +1,9 @@
+"""Intra-operator (sharding) parallelization.
+
+TPU-native analog of the reference ``alpa/shard_parallel/`` (SURVEY.md §2.3):
+the forked-XLA C++ AutoSharding pass + Python ILP callback is replaced by a
+pure-Python planner over the jaxpr that emits ``jax.sharding.NamedSharding``
+constraints consumed by pjit/GSPMD in stock libtpu.
+"""
+from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+from alpa_tpu.shard_parallel.manual_sharding import ManualShardingOption

@@ -1,0 +1,151 @@
+"""Compile a function into a sharded single-mesh executable.
+
+Analog of ref ``alpa/shard_parallel/compile_executable.py`` (SURVEY.md §3.2):
+trace -> plan shardings -> (optionally rewrite for gradient accumulation) ->
+jit with NamedShardings -> compile on the mesh.  The reference's two-binary
+grad-accumulation design with runtime all-reduce skipping
+(ref compile_executable.py:159 + mesh_executable.py:855-894) is replaced by a
+single program whose microbatch loop is a ``lax.scan`` (see grad_acc.py).
+"""
+import logging
+import time
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from alpa_tpu.device_mesh import LogicalDeviceMesh, PhysicalDeviceMesh
+from alpa_tpu.global_env import global_config
+from alpa_tpu.mesh_executable import GradAccMeshExecutable, NormalMeshExecutable
+from alpa_tpu.shard_parallel.auto_sharding import (AutoShardingOption,
+                                                  MESH_AXIS_NAMES,
+                                                  plan_rule_based, replicated)
+from alpa_tpu.shard_parallel.manual_sharding import (ManualShardingOption,
+                                                     apply_manual_shardings,
+                                                     flat_specs_from_tree)
+
+logger = logging.getLogger(__name__)
+
+
+def _logical_mesh_for(physical_mesh: PhysicalDeviceMesh,
+                      option: AutoShardingOption) -> LogicalDeviceMesh:
+    shape = option.logical_mesh_shape
+    if shape is None:
+        # Default: 1-D mesh over all devices; the solver may search 2-D
+        # shapes itself (mesh_shape_search).
+        shape = (physical_mesh.num_devices, 1)
+    return physical_mesh.get_logical_mesh(shape)
+
+
+def _pin_state_out_shardings(in_avals, in_shardings, batch_invars,
+                             out_shapes):
+    """Greedy in-order (shape, dtype) matching of output leaves to non-batch
+    input leaves; matched outputs inherit the input sharding, others stay
+    unspecified (inferred by GSPMD).  In-order matching aligns structurally
+    identical state trees (params->new params, mu->new mu, ...)."""
+    flat_outs = jax.tree_util.tree_leaves(out_shapes)
+    unclaimed = {}
+    for i, (aval, is_batch) in enumerate(zip(in_avals, batch_invars)):
+        if not is_batch:
+            unclaimed.setdefault((tuple(aval.shape), np.dtype(aval.dtype)),
+                                 []).append(i)
+    out_shardings = []
+    for o in flat_outs:
+        key = (tuple(o.shape), np.dtype(o.dtype))
+        if unclaimed.get(key):
+            i = unclaimed[key].pop(0)
+            out_shardings.append(in_shardings[i])
+        else:
+            out_shardings.append(None)
+    return out_shardings
+
+
+def compile_shard_executable(
+        fun: Callable,
+        physical_mesh: PhysicalDeviceMesh,
+        in_avals: Sequence[Any],
+        in_tree,
+        in_paths: Sequence[str],
+        donated_invars: Sequence[bool],
+        batch_invars: Sequence[bool],
+        num_micro_batches: Optional[int],
+        as_option: AutoShardingOption,
+        manual_sharding_option: Optional[ManualShardingOption] = None):
+    """Compile ``fun`` (flat signature) into a mesh executable.
+
+    ``fun`` takes flat args and returns flat outputs (the caller handles
+    pytrees).  Mirrors ref compile_shard_executable
+    (shard_parallel/compile_executable.py:54).
+    """
+    tic = time.time()
+    logical_mesh = _logical_mesh_for(physical_mesh, as_option)
+    jax_mesh = logical_mesh.get_jax_mesh(MESH_AXIS_NAMES[:len(
+        logical_mesh.shape)])
+
+    batch_flat_idx = [i for i, b in enumerate(batch_invars) if b]
+
+    if num_micro_batches is not None and num_micro_batches > 1:
+        from alpa_tpu.shard_parallel.grad_acc import (
+            rewrite_for_grad_accumulation)
+        fun, in_avals = rewrite_for_grad_accumulation(
+            fun, in_avals, batch_flat_idx, num_micro_batches)
+        executable_cls = GradAccMeshExecutable
+    else:
+        executable_cls = NormalMeshExecutable
+
+    # ---- plan input shardings ----
+    if as_option.enable_auto_sharding and not as_option.force_data_parallel:
+        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+        in_shardings, constraint_fn = plan_auto_sharding(
+            fun, in_avals, in_paths, batch_flat_idx, logical_mesh, jax_mesh,
+            as_option)
+        if constraint_fn is not None:
+            fun = constraint_fn
+    else:
+        in_shardings = plan_rule_based(jax_mesh, in_avals, in_paths,
+                                       batch_flat_idx, as_option)
+
+    if manual_sharding_option is not None:
+        manual_flat = flat_specs_from_tree(
+            manual_sharding_option.in_axis_resources, in_tree, len(in_avals))
+        if manual_flat is not None:
+            in_shardings = apply_manual_shardings(jax_mesh, in_shardings,
+                                                  manual_flat)
+
+    donate_idx = tuple(i for i, d in enumerate(donated_invars) if d)
+
+    # Pin outputs that structurally correspond to inputs (state -> new state)
+    # to the input's sharding: keeps the state layout stable across steps so
+    # AOT executables can be re-invoked and donation can alias buffers.
+    out_shapes = getattr(fun, "out_shapes", None)
+    if out_shapes is None:
+        out_shapes = jax.eval_shape(fun, *in_avals)
+    out_shardings = _pin_state_out_shardings(in_avals, in_shardings,
+                                             batch_invars, out_shapes)
+
+    jitted = jax.jit(fun,
+                     in_shardings=tuple(in_shardings),
+                     out_shardings=out_shardings,
+                     donate_argnums=donate_idx)
+    lowered = jitted.lower(*in_avals)
+    compiled = lowered.compile()
+    out_avals = [
+        jax.ShapeDtypeStruct(s.shape, s.dtype) for s in lowered.out_info
+    ] if hasattr(lowered, "out_info") else None
+
+    if global_config.print_compilation_time:
+        logger.warning("shard-parallel compile took %.2f s", time.time() - tic)
+
+    return executable_cls(
+        physical_mesh,
+        compiled,
+        in_avals=in_avals,
+        out_avals=out_avals,
+        in_shardings=in_shardings,
+        out_shardings=list(compiled.output_shardings),
+        in_tree=in_tree,
+        out_tree=None,  # set by the caller
+        donated_invars=donated_invars,
+        flop_count=None,
+    )

@@ -1,0 +1,43 @@
+"""Manual (pjit-style) sharding constraints on inputs/outputs.
+
+Analog of ref ``alpa/shard_parallel/manual_sharding.py`` (SURVEY.md §2.3):
+``ManualShardingOption`` carries user PartitionSpecs that override the
+planner's choice for specific args/outputs.
+"""
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+
+@dataclasses.dataclass
+class ManualShardingOption:
+    """User-specified in/out PartitionSpecs (pytree prefixes allowed).
+
+    ``mesh_axis_names`` names the logical mesh dims the specs refer to.
+    (ref manual_sharding.py:19 ManualShardingOption)
+    """
+    mesh_axis_names: Optional[Tuple[str, ...]] = None
+    in_axis_resources: Any = None   # pytree of PartitionSpec or None
+    out_axis_resources: Any = None  # pytree of PartitionSpec or None
+
+
+def flat_specs_from_tree(tree_specs, in_tree, num_leaves) -> Optional[list]:
+    """Flatten a (possibly prefix) pytree of PartitionSpecs to a flat list."""
+    if tree_specs is None:
+        return None
+    import jax
+    from jax.api_util import flatten_axes
+    return list(
+        flatten_axes("manual_sharding specs", in_tree, tree_specs))
+
+
+def apply_manual_shardings(mesh, flat_shardings, manual_specs_flat):
+    """Override planner shardings with user-provided specs where given."""
+    out = []
+    for auto, spec in zip(flat_shardings, manual_specs_flat):
+        if spec is None:
+            out.append(auto)
+        else:
+            out.append(NamedSharding(mesh, spec))
+    return out

@@ -1,0 +1,36 @@
+"""Auto-sharding strategy search (jaxpr-level ILP).
+
+Replaces the reference's C++ AutoSharding pass + PuLP ILP callback
+(ref alpa/shard_parallel/auto_sharding.py:617-872, playground/
+auto_sharding_solver/).  Strategy vectors are enumerated per jaxpr equation,
+costs come from the LogicalDeviceMesh alpha-beta model, and the one-hot
+selection problem is solved with scipy's MILP (HiGHS).  The chosen strategies
+become pjit in_shardings + with_sharding_constraint on intermediates.
+
+This module currently implements the planner skeleton with a rule-based
+fallback; the full per-equation ILP lands in strategy.py/ilp.py.
+"""
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+from alpa_tpu.shard_parallel.auto_sharding import (AutoShardingOption,
+                                                  plan_rule_based)
+
+
+def plan_auto_sharding(fun: Callable,
+                       in_avals: Sequence[Any],
+                       in_paths: Sequence[str],
+                       batch_flat_idx: Sequence[int],
+                       logical_mesh,
+                       jax_mesh,
+                       option: AutoShardingOption
+                       ) -> Tuple[list, Optional[Callable]]:
+    """Return (flat in_shardings, optional wrapped fun with internal
+    sharding constraints)."""
+    try:
+        from alpa_tpu.shard_parallel.strategy import plan_with_ilp
+        return plan_with_ilp(fun, in_avals, in_paths, batch_flat_idx,
+                             logical_mesh, jax_mesh, option)
+    except ImportError:
+        shardings = plan_rule_based(jax_mesh, in_avals, in_paths,
+                                    batch_flat_idx, option)
+        return shardings, None

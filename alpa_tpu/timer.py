@@ -1,0 +1,106 @@
+"""Named timers and an event tracer.
+
+TPU-native analog of the reference ``alpa/timer.py:7-94``.  ``sync_func`` on
+TPU blocks on outstanding device work via ``jax.block_until_ready`` /
+``jax.effects_barrier`` rather than cudaDeviceSynchronize.
+"""
+import time
+from collections import namedtuple
+
+TracerEvent = namedtuple("TracerEvent", ("tstamp", "name", "info"))
+
+
+class _Timer:
+    """A named timer with start/stop/elapsed, mirroring ref timer semantics."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self.start_time = None
+        # Each (start, stop) pair adds one entry.
+        self.costs = []
+
+    def start(self, sync_func=None):
+        assert not self.started, f"timer {self.name} already started"
+        if sync_func:
+            sync_func()
+        self.start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, sync_func=None):
+        assert self.started, f"timer {self.name} not started"
+        if sync_func:
+            sync_func()
+        self.costs.append(time.perf_counter() - self.start_time)
+        self.started = False
+
+    def reset(self):
+        self.started = False
+        self.start_time = None
+        self.costs = []
+
+    def elapsed(self, mode: str = "average"):
+        if not self.costs:
+            return 0.0
+        if mode == "average":
+            return sum(self.costs) / len(self.costs)
+        if mode == "sum":
+            return sum(self.costs)
+        if mode == "last":
+            return self.costs[-1]
+        raise ValueError(f"unknown mode {mode}")
+
+    def log(self, mode: str = "average", normalizer: float = 1.0):
+        print(f"timer {self.name}: {self.elapsed(mode) / normalizer:.6f} s")
+
+
+class Timers:
+    """A registry of named timers (ref: alpa/timer.py Timers)."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def __contains__(self, name: str):
+        return name in self.timers
+
+    def reset_all(self):
+        for t in self.timers.values():
+            t.reset()
+
+    def log(self, names=None, mode="average", normalizer=1.0):
+        for name in (names or self.timers):
+            self.timers[name].log(mode, normalizer)
+
+
+class Tracer:
+    """Timestamped event log, dumpable as a Chrome trace
+    (ref: alpa/timer.py:81-94 + pipeshard_executable.py:592)."""
+
+    def __init__(self):
+        self.events = []
+
+    def log(self, name: str, info: str = ""):
+        self.events.append(TracerEvent(time.time(), name, info))
+
+    def clear(self):
+        self.events = []
+
+    def to_chrome_trace(self, pid: int = 0):
+        """Render events as Chrome trace 'instant' records."""
+        return [{
+            "name": ev.name,
+            "ph": "i",
+            "ts": ev.tstamp * 1e6,
+            "pid": pid,
+            "tid": 0,
+            "args": {"info": ev.info},
+        } for ev in self.events]
+
+
+timers = Timers()
+tracer = Tracer()

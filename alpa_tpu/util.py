@@ -1,0 +1,397 @@
+"""Shared utilities for alpa_tpu.
+
+TPU-native analog of the reference's ``alpa/util.py``.  The Ray placement
+group, NCCL and pickled-HLO helpers disappear; the jaxpr manipulation, HLO
+text analysis, and flops-accounting helpers survive in jax-idiomatic form.
+"""
+import functools
+import itertools
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.tree_util import tree_flatten, tree_unflatten
+from jax.extend.core import ClosedJaxpr, Jaxpr, Var, Literal
+
+########################################
+# Data structures
+########################################
+
+
+class OrderedSet:
+    """Insertion-ordered set (ref: alpa/util.py:159)."""
+
+    def __init__(self, iterable=()):
+        self._dict = dict.fromkeys(iterable)
+
+    def add(self, item):
+        self._dict[item] = None
+
+    def update(self, iterable):
+        for x in iterable:
+            self._dict[x] = None
+
+    def discard(self, item):
+        self._dict.pop(item, None)
+
+    def remove(self, item):
+        del self._dict[item]
+
+    def union(self, *others):
+        out = OrderedSet(self)
+        for o in others:
+            out.update(o)
+        return out
+
+    def intersection(self, *others):
+        out = OrderedSet()
+        for x in self._dict:
+            if all(x in o for o in others):
+                out.add(x)
+        return out
+
+    def difference(self, *others):
+        out = OrderedSet()
+        for x in self._dict:
+            if not any(x in o for o in others):
+                out.add(x)
+        return out
+
+    def intersection_update(self, *others):
+        self._dict = self.intersection(*others)._dict
+
+    def difference_update(self, *others):
+        self._dict = self.difference(*others)._dict
+
+    def pop(self):
+        key = next(iter(self._dict))
+        del self._dict[key]
+        return key
+
+    def __or__(self, other):
+        return self.union(other)
+
+    def __and__(self, other):
+        return self.intersection(other)
+
+    def __sub__(self, other):
+        return self.difference(other)
+
+    def __contains__(self, item):
+        return item in self._dict
+
+    def __iter__(self):
+        return iter(self._dict)
+
+    def __len__(self):
+        return len(self._dict)
+
+    def __bool__(self):
+        return bool(self._dict)
+
+    def __repr__(self):
+        return f"OrderedSet({list(self._dict)})"
+
+    def __eq__(self, other):
+        if isinstance(other, (OrderedSet, set, frozenset)):
+            return set(self._dict) == set(other)
+        return NotImplemented
+
+
+########################################
+# jaxpr helpers
+########################################
+
+
+def clone_jaxpr(closed_jaxpr: ClosedJaxpr,
+                invars=None,
+                outvars=None,
+                eqns=None,
+                constvars=None,
+                consts=None) -> ClosedJaxpr:
+    """Build a new ClosedJaxpr overriding selected fields."""
+    jaxpr = closed_jaxpr.jaxpr
+    new_jaxpr = jaxpr.replace(
+        invars=list(invars) if invars is not None else jaxpr.invars,
+        outvars=list(outvars) if outvars is not None else jaxpr.outvars,
+        eqns=list(eqns) if eqns is not None else jaxpr.eqns,
+        constvars=list(constvars) if constvars is not None else jaxpr.constvars,
+    )
+    new_consts = list(consts) if consts is not None else closed_jaxpr.consts
+    return ClosedJaxpr(new_jaxpr, new_consts)
+
+
+def new_jaxpr_eqn(invars, outvars, primitive, params, effects=None,
+                  source_info=None):
+    """Create a JaxprEqn across jax versions."""
+    from jax._src import core as src_core
+    return src_core.new_jaxpr_eqn(invars, outvars, primitive, params,
+                                  effects or src_core.no_effects, source_info)
+
+
+_var_count = itertools.count()
+
+
+def gensym_var(aval, suffix: str = "") -> Var:
+    """Create a fresh Var with the given abstract value."""
+    from jax._src import core as src_core
+    try:
+        return src_core.Var(aval)
+    except TypeError:
+        return src_core.Var(suffix, aval)
+
+
+def eqn_invars_nonlit(eqn) -> List[Var]:
+    return [v for v in eqn.invars if isinstance(v, Var)]
+
+
+def jaxpr_free_vars(jaxpr: Jaxpr) -> OrderedSet:
+    """Variables read before being defined (excluding invars/constvars)."""
+    defined = OrderedSet(jaxpr.constvars)
+    defined.update(jaxpr.invars)
+    free = OrderedSet()
+    for eqn in jaxpr.eqns:
+        for v in eqn.invars:
+            if isinstance(v, Var) and v not in defined:
+                free.add(v)
+        defined.update(eqn.outvars)
+    for v in jaxpr.outvars:
+        if isinstance(v, Var) and v not in defined:
+            free.add(v)
+    return free
+
+
+def abstractify_with_aval(x):
+    if hasattr(x, "aval"):
+        return x.aval
+    return jax.api_util.shaped_abstractify(x)
+
+
+def trace_to_closed_jaxpr(fun: Callable, *avals) -> Tuple[ClosedJaxpr, Any]:
+    """Trace ``fun`` on abstract values; returns (closed_jaxpr, out_tree)."""
+    jaxpr, out_shapes = jax.make_jaxpr(fun, return_shape=True)(*avals)
+    out_tree = jax.tree_util.tree_structure(out_shapes)
+    return jaxpr, out_tree
+
+
+########################################
+# HLO text analysis
+########################################
+
+# Matches the opcode position in an HLO instruction line:
+#   %name = f32[128]{0} all-reduce(...)
+#   %name = (f32[4]{0}, f32[4]{0}) all-reduce-start(...)
+# Group 1 captures the opcode; operand references never match because they
+# appear inside the parens, after the opcode.
+_HLO_OP_RE = re.compile(
+    r"=\s*(?:\([^=]*?\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s*"
+    r"([a-z0-9-]+)(?:\.\d+)?\(")
+
+_COLLECTIVE_OPS = {
+    "all-reduce": ("all-reduce", "all-reduce-start"),
+    "all-gather": ("all-gather", "all-gather-start"),
+    "reduce-scatter": ("reduce-scatter",),
+    "all-to-all": ("all-to-all",),
+    "collective-permute": ("collective-permute", "collective-permute-start"),
+}
+_OP_TO_KIND = {op: kind for kind, ops in _COLLECTIVE_OPS.items() for op in ops}
+
+
+def count_communication_primitives(hlo_text: str,
+                                   ignore_scalar_all_reduce: bool = False):
+    """Count collectives in optimized HLO text.
+
+    TPU analog of ref ``alpa/util.py:400``: returns
+    (total, all_reduce, all_gather, reduce_scatter, all_to_all).
+    Only counts op definitions (opcode position), not operand references.
+    """
+    counts = {k: 0 for k in _COLLECTIVE_OPS}
+    for line in hlo_text.splitlines():
+        m = _HLO_OP_RE.search(line)
+        if not m:
+            continue
+        kind = _OP_TO_KIND.get(m.group(1))
+        if kind is None:
+            continue
+        if (ignore_scalar_all_reduce and kind == "all-reduce" and
+                re.search(r"=\s*[a-z0-9]+\[\]", line)):
+            continue
+        counts[kind] += 1
+    total = sum(counts.values())
+    return (total, counts["all-reduce"], counts["all-gather"],
+            counts["reduce-scatter"], counts["all-to-all"])
+
+
+def get_compiled_hlo_text(fn, *args, **jit_kwargs) -> str:
+    """Compile a function and return post-optimization HLO text."""
+    return jax.jit(fn, **jit_kwargs).lower(*args).compile().as_text()
+
+
+########################################
+# Benchmark / flops accounting
+########################################
+
+
+def compute_gpt_parameter_count(num_layers, hidden_size, vocab_size):
+    """Analytic GPT param count (ref: alpa/util.py 'compute_gpt_parameter_count')."""
+    return (num_layers * (
+        # self attention
+        hidden_size * (3 * hidden_size + 1) + hidden_size * (hidden_size + 1) +
+        # mlp
+        hidden_size * (4 * hidden_size + 1) + hidden_size * 4 * (hidden_size + 1) +
+        # layer norm
+        hidden_size * 4) + vocab_size * (hidden_size + 1))
+
+
+def compute_gpt_tflops(batch_size,
+                       seq_len,
+                       num_layers,
+                       hidden_size,
+                       vocab_size,
+                       num_devices,
+                       latency,
+                       backward=True,
+                       checkpoint_activations=False):
+    """Analytic GPT TFLOPS (ref: alpa/util.py:1658-1692)."""
+    factor = 24
+    if backward:
+        factor += 48
+        if checkpoint_activations:
+            factor += 24
+    total_flop = (factor * batch_size * seq_len * (hidden_size**2) * num_layers *
+                  (1 + seq_len / (6 * hidden_size)) +
+                  (6 if backward else 2) * batch_size * seq_len * hidden_size * vocab_size)
+    tflops = total_flop / latency / num_devices / 1e12
+    return tflops
+
+
+def compute_moe_tflops(batch_size, seq_len, num_layers, hidden_size,
+                       group_size, vocab_size, num_experts, num_devices,
+                       latency, backward=True, checkpoint_activations=False,
+                       mlp_factor=8):
+    """Analytic MoE transformer TFLOPS (ref: alpa/util.py compute_moe_tflops)."""
+    factor = 24 if not backward else 72
+    if checkpoint_activations:
+        factor += 24
+    pure_transformer = (batch_size * seq_len * (hidden_size**2) * num_layers / 2 *
+                        (factor / 24) * 24 * (1 + seq_len / (6 * hidden_size)))
+    moe_transformer = (batch_size * seq_len * (hidden_size**2) * num_layers / 2 *
+                       (factor / 24) * (4 * mlp_factor + 8))
+    embedding = ((6 if backward else 2) * batch_size * seq_len * hidden_size *
+                 vocab_size)
+    total_flop = pure_transformer + moe_transformer + embedding
+    return total_flop / latency / num_devices / 1e12
+
+
+def write_tsv(heads: Sequence[str],
+              values: Sequence[Any],
+              filename: str,
+              print_line: bool = True):
+    """Append one TSV record (ref: alpa/util.py:1276)."""
+    assert len(heads) == len(values)
+    with open(filename, mode="a", encoding="utf-8") as fout:
+        fout.write("\t".join(str(x) for x in values) + "\n")
+    if print_line:
+        print(" | ".join(f"{h}: {v}" for h, v in zip(heads, values)))
+
+
+def benchmark_func(run_func,
+                   sync_func=None,
+                   warmup=1,
+                   repeat=3,
+                   number=5) -> np.ndarray:
+    """Time run_func; returns per-repeat average seconds (ref util.benchmark_func)."""
+    for _ in range(warmup):
+        run_func()
+    if sync_func:
+        sync_func()
+    costs = []
+    for _ in range(repeat):
+        if sync_func:
+            sync_func()
+        tic = time.perf_counter()
+        for _ in range(number):
+            run_func()
+        if sync_func:
+            sync_func()
+        costs.append((time.perf_counter() - tic) / number)
+    return np.array(costs)
+
+
+########################################
+# Tree/arg helpers
+########################################
+
+
+def tree_leaf_count(tree) -> int:
+    return len(tree_flatten(tree)[0])
+
+
+def split_list(lst, sizes):
+    """Split a flat list into chunks of the given sizes."""
+    out, start = [], 0
+    for s in sizes:
+        out.append(lst[start:start + s])
+        start += s
+    assert start == len(lst)
+    return out
+
+
+def to_int_tuple(x) -> Tuple[int, ...]:
+    return tuple(int(v) for v in x)
+
+
+def divide_evenly(total: int, parts: int) -> List[int]:
+    base, rem = divmod(total, parts)
+    return [base + (1 if i < rem else 0) for i in range(parts)]
+
+
+def aval_bytes(aval) -> int:
+    return int(np.prod(aval.shape)) * aval.dtype.itemsize if aval.shape else aval.dtype.itemsize
+
+
+def jaxpr_eqn_flops(eqn) -> float:
+    """Cheap analytic flop count for one jaxpr eqn.
+
+    Mirrors ref ``alpa/pipeline_parallel/layer_stats.py:eqn_flops`` in spirit:
+    dots and convs dominate; elementwise ops count size; control-flow counts
+    its body.
+    """
+    prim = eqn.primitive.name
+    if prim == "dot_general":
+        d = eqn.params["dimension_numbers"]
+        (lhs_contract, _), (lhs_batch, _) = d
+        lhs = eqn.invars[0].aval
+        rhs = eqn.invars[1].aval
+        out = eqn.outvars[0].aval
+        contract_size = int(np.prod([lhs.shape[i] for i in lhs_contract])) or 1
+        return 2.0 * float(np.prod(out.shape)) * contract_size
+    if prim in ("conv_general_dilated",):
+        out = eqn.outvars[0].aval
+        rhs = eqn.invars[1].aval
+        return 2.0 * float(np.prod(out.shape)) * float(np.prod(rhs.shape[:-1]))
+    if prim in ("custom_jvp_call", "custom_vjp_call", "pjit", "closed_call",
+                "remat", "checkpoint", "custom_vjp_call_jaxpr"):
+        sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if sub is None:
+            return 0.0
+        sub_jaxpr = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+        return sum(jaxpr_eqn_flops(e) for e in sub_jaxpr.eqns)
+    if prim in ("scan", "while"):
+        sub = eqn.params.get("jaxpr") or eqn.params.get("body_jaxpr")
+        if sub is None:
+            return 0.0
+        sub_jaxpr = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+        n = eqn.params.get("length", 1)
+        return n * sum(jaxpr_eqn_flops(e) for e in sub_jaxpr.eqns)
+    if eqn.outvars and hasattr(eqn.outvars[0], "aval") and eqn.outvars[0].aval.shape:
+        return float(np.prod(eqn.outvars[0].aval.shape))
+    return 0.0
+
+
+def clusters_to_str(clusters) -> str:
+    return " | ".join(",".join(str(x) for x in c) for c in clusters)

@@ -1,0 +1,29 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors SURVEY.md §4's implication (d)/(e): single-host multi-chip tests
+stand in for a pod; compile-only tests need no TPU at all.
+"""
+import os
+
+# Must be set before the first backend use: force an 8-device virtual CPU
+# mesh.  (The axon sitecustomize may have imported jax already and pinned
+# jax_platforms, so we also override via jax.config below.)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags +
+                               " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+os.environ["ALPA_TPU_TESTING"] = "1"
+
+import pytest  # noqa: E402
+
+import alpa_tpu  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_cluster_state():
+    yield
+    alpa_tpu.shutdown()

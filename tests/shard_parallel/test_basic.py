@@ -1,0 +1,89 @@
+"""Shard-parallel correctness + structural tests.
+
+Modeled on ref ``tests/shard_parallel/test_basic.py`` (SURVEY.md §4.2):
+serial-vs-parallel equivalence via assert_allclose plus collective-counting
+assertions on the compiled HLO.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import alpa_tpu
+from alpa_tpu import (DataParallel, ShardParallel, Zero2Parallel,
+                      Zero3Parallel)
+from alpa_tpu.testing import (assert_allclose, create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+from alpa_tpu.util import count_communication_primitives
+
+
+def _run_and_compare(method, n_steps=2, rtol=1e-3):
+    state_serial, batch = create_mlp_train_state_and_batch()
+    state_parallel = state_serial
+
+    serial_step = get_mlp_train_step(None)
+    parallel_step = get_mlp_train_step(method, use_value_and_grad=True)
+
+    for _ in range(n_steps):
+        state_serial, _ = serial_step(state_serial, batch)
+        state_parallel, _ = parallel_step(state_parallel, batch)
+
+    assert_allclose(jax.device_get(state_serial.params),
+                    jax.device_get(state_parallel.params), rtol, rtol)
+    return parallel_step.get_last_executable()
+
+
+class TestShardParallelBasic:
+
+    def test_data_parallel(self):
+        executable = _run_and_compare(DataParallel())
+        hlo = executable.get_hlo_text()
+        # Pure DP: gradient sync -> at least one all-reduce, no all-gather.
+        _, n_ar, n_ag, n_rs, _ = count_communication_primitives(hlo)
+        assert n_ar >= 1, f"expected grad all-reduce, hlo has {n_ar}"
+
+    def test_zero2(self):
+        executable = _run_and_compare(Zero2Parallel())
+        hlo = executable.get_hlo_text()
+        total, n_ar, n_ag, n_rs, _ = count_communication_primitives(hlo)
+        # ZeRO-2: sharded optimizer state => reduce-scatter (or AR+slice
+        # before XLA's pattern match) + all-gather of updates.
+        assert total >= 1
+
+    def test_zero3(self):
+        executable = _run_and_compare(Zero3Parallel())
+        hlo = executable.get_hlo_text()
+        total, n_ar, n_ag, n_rs, _ = count_communication_primitives(hlo)
+        assert total >= 1
+
+    def test_shard_parallel_auto(self):
+        _run_and_compare(ShardParallel())
+
+    def test_explicit_mesh_devices(self):
+        devices = jax.devices()[:4]
+        _run_and_compare(ShardParallel(devices=devices))
+
+    def test_executable_introspection(self):
+        executable = _run_and_compare(DataParallel())
+        assert executable.get_total_allocation_size() != 0
+        assert "HloModule" in executable.get_hlo_text()
+        costs = executable.profile_with_dummy_inputs(repeat=2, number=1)
+        assert np.all(costs > 0)
+
+
+class TestInference:
+
+    def test_forward_only(self):
+        state, batch = create_mlp_train_state_and_batch()
+
+        @alpa_tpu.parallelize(method=ShardParallel(), batch_argnums=(1,))
+        def forward(state, batch):
+            return state.apply_fn(state.params, batch["x"])
+
+        out = forward(state, batch)
+        expected = state.apply_fn(state.params, batch["x"])
+        assert_allclose(np.asarray(out), np.asarray(expected))
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
