@@ -1,0 +1,9 @@
+"""Model zoo: flax models used by tests, benchmarks, and serving.
+
+TPU-native analog of ref ``alpa/model/`` (SURVEY.md §2.8): GPT/BERT
+transformers, MoE, WideResNet, plus TrainState utilities.  Models are
+written mesh-agnostic: parallelization comes entirely from
+``@alpa_tpu.parallelize``; optional ``mark_pipeline_boundary`` calls and a
+pluggable attention implementation (jnp reference / pallas flash / ring)
+are the only parallelism-aware hooks.
+"""

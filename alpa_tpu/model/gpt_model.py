@@ -1,0 +1,236 @@
+"""GPT-style decoder-only transformer (flax), TPU-first.
+
+Clean-room analog of ref ``alpa/model/gpt_model.py`` (which wraps
+``bert_model.py``'s encoder with a causal mask).  Design choices for TPU:
+
+* bfloat16 activations/params option; fp32 layernorm + softmax accumulation,
+* einsum-formulated attention so batch/head/seq dims are clean mesh targets
+  for the auto-sharding planner,
+* pluggable attention implementation (``attention_impl``):
+  "reference" (jnp, XLA-fused) | "flash" (pallas kernel, ops/flash_attention)
+  | "ring" (sequence-parallel ring attention over a mesh axis),
+* optional ``mark_pipeline_boundary()`` between blocks for manual pipeline
+  layer construction (ref ManualLayerOption),
+* KV-cache threading for autoregressive serving (cache as explicit
+  function inputs/outputs, mirroring ref examples/llm_serving/model/
+  opt_model.py:605 init_cache_aval design).
+
+The GPT ladder (125M..76B, ref benchmark/alpa/suite_manual_gpt.py:18-26) is
+reproduced in ``gpt_specs``.
+"""
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alpa_tpu.pipeline_parallel.primitive_def import mark_pipeline_boundary
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    seq_len: int = 1024
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+    # "reference" | "flash" | "ring"
+    attention_impl: str = "reference"
+    # insert pipeline boundary markers every k blocks (0 = never)
+    pipeline_boundary_every: int = 0
+    # mesh axis name for ring attention (sequence parallel)
+    sp_axis: Optional[str] = None
+    tie_embeddings: bool = True
+
+
+# The reference benchmark ladder: name -> (hidden, layers, heads)
+# (ref benchmark/alpa/suite_manual_gpt.py:18-26; seq 1024, vocab 51200)
+gpt_specs = {
+    "125M": (768, 12, 12),
+    "350M": (1024, 24, 16),
+    "760M": (1536, 24, 16),
+    "1.3B": (2048, 24, 32),
+    "2.6B": (2560, 32, 32),
+    "6.7B": (4096, 32, 32),
+    "15B": (5120, 48, 40),
+    "39B": (8192, 48, 64),
+    "76B": (10240, 60, 80),
+}
+
+
+def config_from_spec(name: str, **kwargs) -> GPTConfig:
+    hidden, layers, heads = gpt_specs[name]
+    return GPTConfig(hidden_size=hidden, num_layers=layers, num_heads=heads,
+                     **kwargs)
+
+
+def reference_attention(q, k, v, *, causal: bool, offset=0):
+    """Plain einsum attention; XLA fuses this well on TPU for short seqs.
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D).  fp32 softmax accumulation.
+    """
+    dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    scores = scores / np.sqrt(dim)
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + offset
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = q_pos >= k_pos
+        scores = jnp.where(mask[None, None], scores, jnp.float32(-1e9))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def get_attention_fn(config: GPTConfig) -> Callable:
+    if config.attention_impl == "flash":
+        from alpa_tpu.ops.flash_attention import flash_attention
+        return flash_attention
+    if config.attention_impl == "ring":
+        from alpa_tpu.ops.ring_attention import ring_attention
+        return partial(ring_attention, axis_name=config.sp_axis)
+    return reference_attention
+
+
+class SelfAttention(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, kv_cache=None, deterministic=True):
+        cfg = self.config
+        h, nh = cfg.hidden_size, cfg.num_heads
+        hd = h // nh
+        qkv = nn.Dense(3 * h, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s = x.shape[0], x.shape[1]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+
+        offset = 0
+        new_cache = None
+        if kv_cache is not None:
+            k_cache, v_cache, index = kv_cache
+            # write current k/v at position index (decode: s==1)
+            k_full = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(
+                k_cache.dtype), index, axis=1)
+            v_full = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(
+                v_cache.dtype), index, axis=1)
+            mask_len = index + s
+            pos = jax.lax.broadcasted_iota(jnp.int32, (k_full.shape[1],), 0)
+            keep = pos < mask_len
+            k_use = jnp.where(keep[None, :, None, None], k_full,
+                              jnp.zeros_like(k_full))
+            v_use = jnp.where(keep[None, :, None, None], v_full,
+                              jnp.zeros_like(v_full))
+            # scores to future positions masked by causal offset
+            attn = reference_attention(q, k_use, v_use, causal=True,
+                                       offset=index)
+            new_cache = (k_full, v_full, index + s)
+            out = attn
+        else:
+            attn_fn = get_attention_fn(cfg)
+            out = attn_fn(q, k, v, causal=True)
+        out = out.reshape(b, s, h)
+        out = nn.Dense(h, dtype=cfg.dtype, name="out")(out)
+        return out, new_cache
+
+
+class MLPBlock(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = cfg.hidden_size
+        x = nn.Dense(cfg.mlp_ratio * h, dtype=cfg.dtype, name="fc_in")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.Dense(h, dtype=cfg.dtype, name="fc_out")(x)
+        return x
+
+
+class TransformerBlock(nn.Module):
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, kv_cache=None, deterministic=True):
+        cfg = self.config
+        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        attn_out, new_cache = SelfAttention(cfg, name="attn")(
+            ln1, kv_cache, deterministic)
+        x = x + attn_out.astype(x.dtype)
+        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        x = x + MLPBlock(cfg, name="mlp")(ln2).astype(x.dtype)
+        return x, new_cache
+
+
+class GPTModel(nn.Module):
+    """Decoder-only LM.  Returns logits (and new kv caches if given)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids, position_ids=None, kv_caches=None,
+                 deterministic=True):
+        cfg = self.config
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                           dtype=cfg.dtype, name="wte")
+        x = tok_emb(input_ids)
+        x = x + nn.Embed(cfg.seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                         name="wpe")(position_ids)
+        new_caches = [] if kv_caches is not None else None
+        for i in range(cfg.num_layers):
+            if (cfg.pipeline_boundary_every and i > 0 and
+                    i % cfg.pipeline_boundary_every == 0):
+                mark_pipeline_boundary()
+            cache_i = kv_caches[i] if kv_caches is not None else None
+            x, new_cache = TransformerBlock(cfg, name=f"h{i}")(
+                x, cache_i, deterministic)
+            if new_caches is not None:
+                new_caches.append(new_cache)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = tok_emb.attend(x.astype(cfg.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                              use_bias=False, name="lm_head")(x)
+        if new_caches is not None:
+            return logits, new_caches
+        return logits
+
+
+def init_kv_caches(config: GPTConfig, batch_size: int,
+                   dtype=None) -> list:
+    """KV caches as explicit arrays (ref opt_model.py:605 init_cache_aval)."""
+    dtype = dtype or config.dtype
+    hd = config.hidden_size // config.num_heads
+    shape = (batch_size, config.seq_len, config.num_heads, hd)
+    return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+             jnp.int32(0)) for _ in range(config.num_layers)]
+
+
+def init_gpt(config: GPTConfig, batch_size: int, rngkey=None):
+    """Initialize model + params on host."""
+    rngkey = rngkey if rngkey is not None else jax.random.PRNGKey(0)
+    model = GPTModel(config)
+    dummy = jnp.ones((batch_size, config.seq_len), jnp.int32)
+    params = jax.eval_shape(model.init, rngkey, dummy)
+    params = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), params)
+    return model, params
+
+
+def init_gpt_real(config: GPTConfig, batch_size: int, rngkey=None):
+    rngkey = rngkey if rngkey is not None else jax.random.PRNGKey(0)
+    model = GPTModel(config)
+    dummy = jnp.ones((batch_size, config.seq_len), jnp.int32)
+    params = model.init(rngkey, dummy)
+    return model, params

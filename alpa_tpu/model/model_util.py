@@ -1,0 +1,79 @@
+"""Train state and optimizer utilities.
+
+Analog of ref ``alpa/model/model_util.py`` (TrainState, optimizers incl.
+dynamic loss scale).  Built on flax/optax; the dynamic-scale logic follows
+the standard flax DynamicScale pattern re-expressed so the scale update is
+part of the train step (jit-compatible, no host sync).
+"""
+from typing import Any, Callable, Optional
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax import struct
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """TrainState with optional dynamic loss scaling state and master-copy
+    support (ref model_util.py TrainState)."""
+    dynamic_scale: Optional[Any] = None
+
+    @classmethod
+    def create_with_scale(cls, *, apply_fn, params, tx, use_dynamic_scale=False,
+                          **kwargs):
+        ds = DynamicScaleState.create() if use_dynamic_scale else None
+        return cls.create(apply_fn=apply_fn, params=params, tx=tx,
+                          dynamic_scale=ds, **kwargs)
+
+
+class DynamicScaleState(struct.PyTreeNode):
+    """Loss-scale state for mixed-precision training."""
+    scale: jnp.ndarray
+    growth_interval: int = struct.field(pytree_node=False, default=2000)
+    growth_factor: float = struct.field(pytree_node=False, default=2.0)
+    backoff_factor: float = struct.field(pytree_node=False, default=0.5)
+    fine_count: jnp.ndarray = None
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0**15):
+        return cls(scale=jnp.float32(init_scale),
+                   fine_count=jnp.zeros((), jnp.int32))
+
+    def update(self, grads_finite: jnp.ndarray) -> "DynamicScaleState":
+        grow = (self.fine_count + 1) >= self.growth_interval
+        new_scale = jnp.where(
+            grads_finite,
+            jnp.where(grow, self.scale * self.growth_factor, self.scale),
+            jnp.maximum(self.scale * self.backoff_factor, 1.0))
+        new_count = jnp.where(grads_finite & ~grow, self.fine_count + 1,
+                              jnp.zeros((), jnp.int32))
+        return self.replace(scale=new_scale, fine_count=new_count)
+
+
+def all_finite(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    return jnp.all(
+        jnp.stack([jnp.all(jnp.isfinite(x)) for x in leaves]))
+
+
+def create_adamw(learning_rate=1e-3, weight_decay=0.01, b1=0.9, b2=0.999,
+                 grad_clip: Optional[float] = 1.0):
+    chain = []
+    if grad_clip:
+        chain.append(optax.clip_by_global_norm(grad_clip))
+    chain.append(optax.adamw(learning_rate, b1=b1, b2=b2,
+                             weight_decay=weight_decay))
+    return optax.chain(*chain)
+
+
+def cross_entropy_loss(logits, labels, label_mask=None, vocab_size=None):
+    """Mean token cross-entropy with optional mask."""
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    if label_mask is not None:
+        return (loss * label_mask).sum() / jnp.maximum(label_mask.sum(), 1)
+    return loss.mean()

@@ -79,12 +79,26 @@ def compile_shard_executable(
     (shard_parallel/compile_executable.py:54).
     """
     tic = time.time()
-    logical_mesh = _logical_mesh_for(physical_mesh, as_option)
-    jax_mesh = logical_mesh.get_jax_mesh(MESH_AXIS_NAMES[:len(
-        logical_mesh.shape)])
-
     batch_flat_idx = [i for i, b in enumerate(batch_invars) if b]
 
+    # ---- plan input shardings (on the original, scan-free function) ----
+    if as_option.enable_auto_sharding and not as_option.force_data_parallel:
+        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+        jax_mesh, in_shardings, constraint_fn, _shape = plan_auto_sharding(
+            fun, in_avals, in_paths, batch_flat_idx, physical_mesh,
+            as_option)
+        if constraint_fn is not None:
+            fun = constraint_fn
+    else:
+        logical_mesh = _logical_mesh_for(physical_mesh, as_option)
+        jax_mesh = logical_mesh.get_jax_mesh(
+            MESH_AXIS_NAMES[:len(logical_mesh.shape)])
+        in_shardings = plan_rule_based(jax_mesh, in_avals, in_paths,
+                                       batch_flat_idx, as_option)
+
+    # ---- rewrite for gradient accumulation (after planning: the planner
+    # sees the scan-free full-batch program; shardings carry over since the
+    # rewritten function keeps the same flat signature) ----
     if num_micro_batches is not None and num_micro_batches > 1:
         from alpa_tpu.shard_parallel.grad_acc import (
             rewrite_for_grad_accumulation)
@@ -93,18 +107,6 @@ def compile_shard_executable(
         executable_cls = GradAccMeshExecutable
     else:
         executable_cls = NormalMeshExecutable
-
-    # ---- plan input shardings ----
-    if as_option.enable_auto_sharding and not as_option.force_data_parallel:
-        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
-        in_shardings, constraint_fn = plan_auto_sharding(
-            fun, in_avals, in_paths, batch_flat_idx, logical_mesh, jax_mesh,
-            as_option)
-        if constraint_fn is not None:
-            fun = constraint_fn
-    else:
-        in_shardings = plan_rule_based(jax_mesh, in_avals, in_paths,
-                                       batch_flat_idx, as_option)
 
     if manual_sharding_option is not None:
         manual_flat = flat_specs_from_tree(

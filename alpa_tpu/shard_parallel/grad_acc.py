@@ -1,0 +1,173 @@
+"""Gradient accumulation by jaxpr rewriting.
+
+Analog of ref ``alpa/shard_parallel/compile_executable.py:159-429``
+(``shard_parallel_internal_gradient_accumulation`` +
+``add_gradient_accumulation``): the traced train step is split at the
+gradient marker (inserted by ``alpa_tpu.grad``/``value_and_grad``) into a
+*compute_grad* section and an *apply_grad* section.
+
+TPU-native difference: the reference compiles two XLA binaries and skips the
+grad-sync all-reduce on all but the last microbatch with a runtime env-var
+hook (ref mesh_executable.py:855-894) — impossible on TPU where collectives
+are compiled in.  Here the microbatch loop is a ``lax.scan`` *inside one
+program*: XLA keeps the per-microbatch gradient partial sums local and the
+cross-replica reduction happens once where the accumulated gradient is
+consumed, which is the same communication volume (one all-reduce per step).
+"""
+import logging
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax._src.core import jaxpr_as_fun
+from jax.extend.core import ClosedJaxpr, Var
+
+from alpa_tpu.pipeline_parallel.primitive_def import is_marker
+from alpa_tpu.util import clone_jaxpr
+
+logger = logging.getLogger(__name__)
+
+
+def split_jaxpr_at_grad_marker(closed_jaxpr: ClosedJaxpr):
+    """Split a jaxpr's eqns at the (single) gradient marker.
+
+    Returns (compute_eqns, marker_eqn, apply_eqns).  Mirrors ref
+    ``split_compute_grad_and_apply_grad`` (pipeline_parallel/apply_grad.py:351)
+    but at shard-parallel level.
+    """
+    jaxpr = closed_jaxpr.jaxpr
+    marker_idx = [
+        i for i, eqn in enumerate(jaxpr.eqns) if is_marker(eqn, "grad")
+    ]
+    if not marker_idx:
+        return None
+    if len(marker_idx) > 1:
+        raise ValueError(
+            "Gradient accumulation requires exactly one alpa_tpu.grad / "
+            f"value_and_grad call; found {len(marker_idx)} gradient markers.")
+    i = marker_idx[0]
+    return jaxpr.eqns[:i], jaxpr.eqns[i], jaxpr.eqns[i + 1:]
+
+
+def rewrite_for_grad_accumulation(fun: Callable,
+                                  in_avals: Sequence[Any],
+                                  batch_flat_idx: Sequence[int],
+                                  num_micro_batches: int
+                                  ) -> Tuple[Callable, Sequence[Any]]:
+    """Rewrite ``fun`` (flat signature, full-batch avals) into a
+    microbatch-scanning equivalent.
+
+    The rewritten function takes the SAME full-batch avals; internally it
+    reshapes each batch arg to ``(num_micro_batches, B/num_micro_batches,
+    ...)``, scans the compute_grad section accumulating every
+    gradient-marked value, divides by ``num_micro_batches`` (mean-loss
+    semantics, ref ``apply_grad_get_mean`` apply_grad.py:650), and runs the
+    apply_grad section once.
+    """
+    batch_set = set(batch_flat_idx)
+    micro_avals = []
+    for i, aval in enumerate(in_avals):
+        if i in batch_set:
+            b = aval.shape[0]
+            if b % num_micro_batches != 0:
+                raise ValueError(
+                    f"Batch size {b} of arg {i} is not divisible by "
+                    f"num_micro_batches={num_micro_batches}")
+            micro_avals.append(
+                jax.ShapeDtypeStruct((b // num_micro_batches,) +
+                                     tuple(aval.shape[1:]), aval.dtype))
+        else:
+            micro_avals.append(aval)
+
+    closed_jaxpr = jax.make_jaxpr(fun)(*micro_avals)
+    split = split_jaxpr_at_grad_marker(closed_jaxpr)
+    if split is None:
+        raise ValueError(
+            "num_micro_batches > 1 requires using alpa_tpu.grad or "
+            "alpa_tpu.value_and_grad inside the parallelized function so the "
+            "gradient boundary can be found.")
+    compute_eqns, marker_eqn, apply_eqns = split
+    jaxpr = closed_jaxpr.jaxpr
+    invars = list(jaxpr.invars)
+    invar_pos = {v: i for i, v in enumerate(invars)}
+
+    # Values accumulated across microbatches: the marker's inputs.
+    acc_invars = [v for v in marker_eqn.invars if isinstance(v, Var)]
+    acc_avals = [v.aval for v in acc_invars]
+
+    # --- compute_grad sub-jaxpr: invars -> marker inputs ---
+    compute_cj = clone_jaxpr(closed_jaxpr,
+                             invars=invars,
+                             outvars=acc_invars,
+                             eqns=list(compute_eqns))
+
+    # --- apply_grad sub-jaxpr: (invars, marker outputs) -> outputs ---
+    # Validate that nothing besides marker outputs / invars / constvars
+    # crosses the boundary.
+    defined_before = set()
+    for eqn in compute_eqns:
+        defined_before.update(eqn.outvars)
+    marker_outs = list(marker_eqn.outvars)
+    allowed = set(invars) | set(marker_outs) | set(jaxpr.constvars)
+    for eqn in apply_eqns:
+        for v in eqn.invars:
+            if isinstance(v, Var) and v in defined_before and v not in allowed:
+                raise ValueError(
+                    "A value computed before alpa_tpu.grad is used after it "
+                    f"without passing through the gradient marker: {v}. "
+                    "Return it through the loss/aux outputs instead.")
+    for v in jaxpr.outvars:
+        if isinstance(v, Var) and v in defined_before and v not in allowed:
+            raise ValueError(
+                "A function output bypasses the gradient marker; with "
+                "num_micro_batches > 1 every output must be derived from "
+                "marked values or inputs.")
+
+    # Batch args must not be consumed after the gradient marker: apply_grad
+    # runs once on full-batch args while the jaxpr was traced at microbatch
+    # shape.
+    batch_vars = {invars[i] for i in batch_set if i < len(invars)}
+    for eqn in apply_eqns:
+        for v in eqn.invars:
+            if isinstance(v, Var) and v in batch_vars:
+                raise ValueError(
+                    "A batch argument is used after alpa_tpu.grad; with "
+                    "num_micro_batches > 1 the apply-gradient section may "
+                    "only consume state and gradient-marked values.")
+
+    apply_cj = clone_jaxpr(closed_jaxpr,
+                           invars=invars + marker_outs,
+                           outvars=list(jaxpr.outvars),
+                           eqns=list(apply_eqns))
+
+    num_args = len(in_avals)
+    batch_list = sorted(batch_set)
+    compute_fn = jaxpr_as_fun(compute_cj)
+    apply_fn = jaxpr_as_fun(apply_cj)
+
+    def grad_acc_fun(*full_args):
+        assert len(full_args) == num_args
+        # Reshape batch args to (num_micro_batches, micro, ...).
+        stacked = []
+        for i in batch_list:
+            a = full_args[i]
+            stacked.append(
+                a.reshape((num_micro_batches, a.shape[0] // num_micro_batches)
+                          + a.shape[1:]))
+
+        def body(acc, mb_slices):
+            args = list(full_args)
+            for i, s in zip(batch_list, mb_slices):
+                args[i] = s
+            vals = compute_fn(*args)
+            new_acc = [a + v for a, v in zip(acc, vals)]
+            return new_acc, None
+
+        acc0 = [jnp.zeros(a.shape, a.dtype) for a in acc_avals]
+        acc, _ = lax.scan(body, acc0, stacked, length=num_micro_batches)
+        acc = [a / num_micro_batches for a in acc]
+        return apply_fn(*full_args, *acc)
+
+    return grad_acc_fun, list(in_avals)

@@ -1,36 +1,128 @@
-"""Auto-sharding strategy search (jaxpr-level ILP).
+"""Auto-sharding driver: mesh-shape search + strategy graph + ILP.
 
-Replaces the reference's C++ AutoSharding pass + PuLP ILP callback
-(ref alpa/shard_parallel/auto_sharding.py:617-872, playground/
-auto_sharding_solver/).  Strategy vectors are enumerated per jaxpr equation,
-costs come from the LogicalDeviceMesh alpha-beta model, and the one-hot
-selection problem is solved with scipy's MILP (HiGHS).  The chosen strategies
-become pjit in_shardings + with_sharding_constraint on intermediates.
+Replaces the reference's ``run_auto_sharding_pass``
+(``alpa/shard_parallel/auto_sharding.py:172-370``, which drives the forked
+C++ AutoSharding pass): traces the flat function, builds the jaxpr-level
+strategy graph (strategy.py), solves the one-hot ILP (ilp.py) for every
+candidate logical mesh shape (the analog of the reference's logical-shape
+enumeration in stage_construction.py:456-526), and emits the winning
+assignment as pjit ``in_shardings``.
 
-This module currently implements the planner skeleton with a rule-based
-fallback; the full per-equation ILP lands in strategy.py/ilp.py.
+GSPMD sharding propagation in stock libtpu then plays the role of the
+reference's SPMD partitioner pass: with all inputs optimally sharded,
+propagation reproduces the intra-op plan (column/row-parallel dots, ZeRO
+layouts) without any custom XLA pass.
 """
-from typing import Any, Callable, Optional, Sequence, Tuple
+import logging
+import time
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from alpa_tpu.device_mesh import PhysicalDeviceMesh
+from alpa_tpu.global_env import global_config
 from alpa_tpu.shard_parallel.auto_sharding import (AutoShardingOption,
-                                                  plan_rule_based)
+                                                  MESH_AXIS_NAMES)
+from alpa_tpu.shard_parallel.ilp import solution_cost, solve_strategy_graph
+from alpa_tpu.shard_parallel.sharding_spec import spec_to_partition_spec
+from alpa_tpu.shard_parallel.strategy import build_strategy_graph
+
+logger = logging.getLogger(__name__)
+
+
+def candidate_mesh_shapes(num_devices: int,
+                          option: AutoShardingOption,
+                          symmetric_axes: bool = False
+                          ) -> List[Tuple[int, int]]:
+    """2-D logical shapes to search (ref stage_construction.py:456-526)."""
+    if option.logical_mesh_shape is not None:
+        return [tuple(option.logical_mesh_shape)]
+    shapes = []
+    d = 1
+    while d <= num_devices:
+        if num_devices % d == 0:
+            shapes.append((d, num_devices // d))
+        d *= 2
+    if symmetric_axes:
+        # On a single host the two axes have identical alpha/beta, so
+        # (d, n/d) and (n/d, d) build isomorphic graphs — search one.
+        shapes = [s for s in shapes if s[0] <= s[1]] or shapes[:1]
+    return shapes
 
 
 def plan_auto_sharding(fun: Callable,
                        in_avals: Sequence[Any],
                        in_paths: Sequence[str],
                        batch_flat_idx: Sequence[int],
-                       logical_mesh,
-                       jax_mesh,
-                       option: AutoShardingOption
-                       ) -> Tuple[list, Optional[Callable]]:
-    """Return (flat in_shardings, optional wrapped fun with internal
-    sharding constraints)."""
-    try:
-        from alpa_tpu.shard_parallel.strategy import plan_with_ilp
-        return plan_with_ilp(fun, in_avals, in_paths, batch_flat_idx,
-                             logical_mesh, jax_mesh, option)
-    except ImportError:
-        shardings = plan_rule_based(jax_mesh, in_avals, in_paths,
-                                    batch_flat_idx, option)
-        return shardings, None
+                       physical_mesh: PhysicalDeviceMesh,
+                       option: AutoShardingOption):
+    """Search logical mesh shapes; returns
+    (jax_mesh, flat in_shardings, constraint_fn or None, chosen_shape)."""
+    closed_jaxpr = jax.make_jaxpr(fun)(*in_avals)
+
+    best = None
+    tic = time.time()
+    for shape in candidate_mesh_shapes(physical_mesh.num_devices, option,
+                                       physical_mesh.num_hosts == 1):
+        logical_mesh = physical_mesh.get_logical_mesh(shape)
+        graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
+                                     batch_flat_idx, option)
+        choice = solve_strategy_graph(graph, option.solver_timeout)
+        cost = solution_cost(graph, choice)
+        logger.debug("mesh shape %s: cost %.4f (%s)", shape, cost,
+                     graph.stats())
+        if best is None or cost < best[0]:
+            best = (cost, shape, logical_mesh, graph, choice)
+    cost, shape, logical_mesh, graph, choice = best
+    if global_config.print_compilation_time:
+        logger.warning("auto-sharding search took %.2f s; picked %s "
+                       "(cost %.4f)", time.time() - tic, shape, cost)
+
+    axis_names = MESH_AXIS_NAMES[:len(shape)]
+    jax_mesh = logical_mesh.get_jax_mesh(axis_names)
+
+    # Assemble invar shardings from the solved assignment.
+    in_shardings: List[Optional[NamedSharding]] = [None] * len(in_avals)
+    for node, s in zip(graph.nodes, choice):
+        if node.kind == "invar" and node.invar_idx is not None:
+            spec = node.strategies[s].out_spec
+            in_shardings[node.invar_idx] = NamedSharding(
+                jax_mesh, spec_to_partition_spec(spec, axis_names))
+    for i, s in enumerate(in_shardings):
+        if s is None:
+            in_shardings[i] = NamedSharding(
+                jax_mesh, spec_to_partition_spec((), axis_names))
+
+    # ZeRO-style overrides on top of the ILP plan (the reference folds these
+    # into ILP forcing flags, auto_sharding.py:225-299).
+    if option.prefer_reduce_scatter or option.force_zero_stage_3:
+        from alpa_tpu.shard_parallel.auto_sharding import (
+            _largest_divisible_dim, shard_dim)
+        # The dp axis is whichever axis the ILP put the batch dim on;
+        # fall back to the largest non-trivial axis.
+        dp_axis_name = None
+        for node, s in zip(graph.nodes, choice):
+            if (node.kind == "invar" and node.invar_idx in batch_flat_idx and
+                    node.strategies[s].out_spec and
+                    node.strategies[s].out_spec[0]):
+                dp_axis_name = axis_names[node.strategies[s].out_spec[0][0]]
+                break
+        if dp_axis_name is None:
+            dp_axis_name = axis_names[int(np.argmax(shape))]
+        dp = dict(jax_mesh.shape)[dp_axis_name]
+        if dp > 1:
+            for i, path in enumerate(in_paths):
+                is_opt = any(k in path for k in ("opt_state", "mu", "nu",
+                                                 "momentum", "trace"))
+                is_param = "params" in path and not is_opt
+                if is_opt or (option.force_zero_stage_3 and is_param):
+                    aval = in_avals[i]
+                    d = _largest_divisible_dim(aval.shape, dp)
+                    if d is not None and in_shardings[i].spec == \
+                            spec_to_partition_spec((), axis_names):
+                        in_shardings[i] = shard_dim(jax_mesh, d, dp_axis_name,
+                                                    len(aval.shape))
+
+    return jax_mesh, in_shardings, None, shape

@@ -1,0 +1,667 @@
+"""Per-equation sharding strategy enumeration + graph construction.
+
+The jaxpr-level re-architecture of the reference's C++ AutoSharding pass
+(``auto_sharding.cc``/``auto_sharding_dot_handler.cc``, reconstructed in
+SURVEY.md §2.9; readable Python spec in ref ``playground/
+auto_sharding_solver/``).  We build a *strategy graph*:
+
+* **nodes** — decision points: graph invars and "heavy" equations
+  (dot_general, conv, reduce, unmappable reshapes, unknown ops).  Each node
+  has a finite list of strategies; a strategy fixes the node's output Spec,
+  a node communication cost (e.g. the all-reduce of a contracted-dim-sharded
+  matmul), and required operand Specs.
+* **follow chains** — cheap ops (elementwise, transpose, broadcast,
+  mappable reshape, convert) don't get nodes; they reuse their lead
+  operand's decision through a dim-mapping (the analog of the reference's
+  strategy "following").
+* **edges** — (producer node, consumer node) pairs with a dense resharding
+  cost matrix C[s_src, s_dst].
+
+The ILP (ilp.py) picks one strategy per node minimizing node + edge costs;
+invar decisions become pjit in_shardings.  GSPMD propagation then realizes
+the dot strategies; emitting with_sharding_constraint on dot outputs (via
+Node.outvar) is the planned fidelity upgrade for cases where propagation
+disagrees with the ILP.
+"""
+import dataclasses
+import itertools
+import logging
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.extend.core import ClosedJaxpr, Jaxpr, Literal, Var
+
+from alpa_tpu.shard_parallel.sharding_spec import (Spec, enumerate_var_specs,
+                                                   is_replicated, make_spec,
+                                                   num_shards,
+                                                   replicated_spec,
+                                                   resharding_cost,
+                                                   spec_valid, used_axes)
+
+logger = logging.getLogger(__name__)
+
+# Ops followed through without creating a decision node.
+ELEMENTWISE_PRIMS = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "atan2",
+    "and", "or", "xor", "shift_left", "shift_right_logical",
+    "shift_right_arithmetic", "nextafter",
+    "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan", "asin",
+    "acos", "atan", "sinh", "cosh", "asinh", "acosh", "atanh", "sqrt",
+    "rsqrt", "cbrt", "logistic", "erf", "erfc", "erf_inv", "abs", "neg",
+    "sign", "floor", "ceil", "round", "is_finite", "not", "integer_pow",
+    "exp2", "square",
+    "eq", "ne", "ge", "gt", "le", "lt", "select_n", "clamp",
+    "convert_element_type", "bitcast_convert_type", "stop_gradient",
+    "copy", "real", "imag", "conj",
+})
+
+# Sub-jaxpr-carrying ops we inline for analysis.
+INLINE_PRIMS = frozenset({
+    "jit", "pjit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr", "remat", "checkpoint",
+    "remat2", "custom_vjp_call_custom_transpose", "custom_lin",
+})
+
+DimMap = Tuple[Optional[int], ...]  # var dim -> node dim (None = fresh dim)
+
+
+def identity_dimmap(ndim: int) -> DimMap:
+    return tuple(range(ndim))
+
+
+def compose_dimmap(outer: DimMap, inner: DimMap) -> DimMap:
+    """outer: var<-mid, inner: mid<-node  =>  var<-node."""
+    return tuple(inner[m] if m is not None else None for m in outer)
+
+
+def map_spec(node_spec: Spec, dimmap: DimMap, ndim: int) -> Tuple[Spec, Tuple[int, ...]]:
+    """Map a node's spec through a dim-mapping.
+
+    Returns (mapped_spec, dropped_axes): mesh axes sharding node dims that
+    the mapping does not carry (they must be all-gathered to realize the
+    follow, charged on the edge).
+    """
+    mapped = [() for _ in range(ndim)]
+    used_node_dims = set()
+    for d, nd in enumerate(dimmap):
+        if nd is not None and nd < len(node_spec):
+            mapped[d] = node_spec[nd]
+            used_node_dims.add(nd)
+    dropped = []
+    for nd, axes in enumerate(node_spec):
+        if nd not in used_node_dims:
+            dropped.extend(axes)
+    return tuple(mapped), tuple(dropped)
+
+
+@dataclasses.dataclass
+class Strategy:
+    name: str
+    out_spec: Spec
+    comm_cost: float
+    # required operand specs, parallel to the node's operand list
+    operand_specs: Tuple[Spec, ...] = ()
+
+
+@dataclasses.dataclass
+class Node:
+    idx: int
+    kind: str  # 'invar' | 'op'
+    aval: Any
+    strategies: List[Strategy]
+    label: str = ""
+    # invar nodes: which flat invar index they represent
+    invar_idx: Optional[int] = None
+    # op nodes: the eqn's primary outvar (for constraint emission)
+    outvar: Optional[Var] = None
+
+
+@dataclasses.dataclass
+class Edge:
+    src: int
+    dst: int
+    # cost[s_src, s_dst]
+    cost: np.ndarray
+
+
+@dataclasses.dataclass
+class StrategyGraph:
+    nodes: List[Node]
+    edges: List[Edge]
+    logical_mesh: Any
+
+    def stats(self):
+        nvars = sum(len(n.strategies) for n in self.nodes)
+        nevars = sum(e.cost.size for e in self.edges)
+        return (f"{len(self.nodes)} nodes / {nvars} strategy vars / "
+                f"{len(self.edges)} edges / {nevars} edge vars")
+
+
+########################################
+# jaxpr flattening (inline sub-jaxprs)
+########################################
+
+
+def _subst(v, env):
+    if isinstance(v, Literal):
+        return v
+    return env.get(v, v)
+
+
+def flatten_jaxpr_eqns(jaxpr: Jaxpr, env: Optional[dict] = None,
+                       depth: int = 0) -> List:
+    """Inline pjit/custom-call/remat sub-jaxprs, returning a flat eqn list
+    over substituted vars.  Scan/while/cond are left opaque (barriers)."""
+    env = env or {}
+    out = []
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        if prim in INLINE_PRIMS and depth < 6:
+            sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or
+                   eqn.params.get("fun_jaxpr"))
+            if sub is None:
+                out.append(eqn.replace(
+                    invars=[_subst(v, env) for v in eqn.invars]))
+                continue
+            sub_jaxpr = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
+            consts = sub.consts if isinstance(sub, ClosedJaxpr) else []
+            inner_env = {}
+            n_const = len(sub_jaxpr.constvars)
+            # pjit-style: invars line up 1:1; custom_jvp has extra prefix
+            # args — align from the end.
+            outer_in = [_subst(v, env) for v in eqn.invars]
+            inner_invars = list(sub_jaxpr.invars)
+            if len(outer_in) >= len(inner_invars):
+                aligned = outer_in[len(outer_in) - len(inner_invars):]
+            else:
+                aligned = outer_in + [None] * (len(inner_invars) -
+                                               len(outer_in))
+            for iv, ov in zip(inner_invars, aligned):
+                if ov is not None:
+                    inner_env[iv] = ov
+            for cv in sub_jaxpr.constvars:
+                # consts become opaque leaf vars (replicated barriers)
+                inner_env[cv] = cv
+            inner_eqns = flatten_jaxpr_eqns(sub_jaxpr, inner_env, depth + 1)
+            out.extend(inner_eqns)
+            # map eqn outvars to inner outvars
+            for ov, inner_ov in zip(eqn.outvars, sub_jaxpr.outvars):
+                env[ov] = _subst(inner_ov, inner_env) \
+                    if not isinstance(inner_ov, Literal) else inner_ov
+        else:
+            out.append(eqn.replace(
+                invars=[_subst(v, env) for v in eqn.invars],
+                outvars=list(eqn.outvars)))
+            # resolve substitutions lazily for later eqns
+    # Second pass: apply env to all invars (outvars of inlined eqns may map)
+    fixed = []
+    for eqn in out:
+        fixed.append(eqn.replace(invars=[_subst(v, env) for v in eqn.invars]))
+    return fixed
+
+
+########################################
+# dot_general strategy enumeration
+########################################
+
+
+def _dot_semantic_dims(eqn):
+    """Classify output dims of a dot_general as (batch, lhs_free, rhs_free)
+    and locate contracting dims on the operands."""
+    (lhs_c, rhs_c), (lhs_b, rhs_b) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    lhs_free = [d for d in range(len(lhs.shape))
+                if d not in lhs_c and d not in lhs_b]
+    rhs_free = [d for d in range(len(rhs.shape))
+                if d not in rhs_c and d not in rhs_b]
+    # out dims: batch..., lhs_free..., rhs_free...
+    return (list(lhs_b), list(rhs_b), list(lhs_c), list(rhs_c), lhs_free,
+            rhs_free)
+
+
+def enumerate_dot_strategies(eqn, logical_mesh) -> List[Strategy]:
+    """The dot handler (analog of ref ``auto_sharding_dot_handler.cc``).
+
+    Enumerates assignments of each non-trivial mesh axis to one semantic
+    role: a batch dim (Sb), an lhs free dim (Si), an rhs free dim (Sj), or
+    a contracting dim (Sk -> all-reduce of the output on that axis).
+    """
+    mesh_shape = logical_mesh.shape
+    lhs_av, rhs_av = eqn.invars[0].aval, eqn.invars[1].aval
+    out_av = eqn.outvars[0].aval
+    lhs_b, rhs_b, lhs_c, rhs_c, lhs_free, rhs_free = _dot_semantic_dims(eqn)
+    nb = len(lhs_b)
+    out_ndim = len(out_av.shape)
+
+    nontrivial = [a for a, s in enumerate(mesh_shape) if s > 1]
+    if not nontrivial:
+        return [Strategy("R", replicated_spec(out_ndim), 0.0,
+                         (replicated_spec(len(lhs_av.shape)),
+                          replicated_spec(len(rhs_av.shape))))]
+
+    # Role choices per mesh axis: ('b', i) / ('i', i) / ('j', i) / ('k', i)
+    role_choices = []
+    for bi in range(nb):
+        role_choices.append(("b", bi))
+    for i_pos, _ in enumerate(lhs_free):
+        role_choices.append(("i", i_pos))
+    for j_pos, _ in enumerate(rhs_free):
+        role_choices.append(("j", j_pos))
+    for k_pos, _ in enumerate(lhs_c):
+        role_choices.append(("k", k_pos))
+
+    strategies = []
+    seen = set()
+    for assignment in itertools.product(role_choices, repeat=len(nontrivial)):
+        # each (role, pos) may appear at most once across axes
+        if len(set(assignment)) != len(assignment):
+            continue
+        lhs_map, rhs_map, out_map = {}, {}, {}
+        ar_axes = []
+        ok = True
+        for axis, (role, pos) in zip(nontrivial, assignment):
+            if role == "b":
+                lhs_map[lhs_b[pos]] = axis
+                rhs_map[rhs_b[pos]] = axis
+                out_map[pos] = axis
+            elif role == "i":
+                lhs_map[lhs_free[pos]] = axis
+                out_map[nb + pos] = axis
+            elif role == "j":
+                rhs_map[rhs_free[pos]] = axis
+                out_map[nb + len(lhs_free) + pos] = axis
+            else:  # k
+                lhs_map[lhs_c[pos]] = axis
+                rhs_map[rhs_c[pos]] = axis
+                ar_axes.append(axis)
+        lhs_spec = make_spec(len(lhs_av.shape), lhs_map)
+        rhs_spec = make_spec(len(rhs_av.shape), rhs_map)
+        out_spec = make_spec(out_ndim, out_map)
+        if not (spec_valid(lhs_av, lhs_spec, mesh_shape) and
+                spec_valid(rhs_av, rhs_spec, mesh_shape) and
+                spec_valid(out_av, out_spec, mesh_shape)):
+            ok = False
+        if not ok:
+            continue
+        out_bytes = (float(np.prod(out_av.shape)) * out_av.dtype.itemsize /
+                     num_shards(out_spec, mesh_shape))
+        cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
+                   for a in ar_axes)
+        name = "".join(f"{r}{p}@{a}" for a, (r, p) in
+                       zip(nontrivial, assignment))
+        key = (lhs_spec, rhs_spec, out_spec)
+        if key in seen:
+            continue
+        seen.add(key)
+        strategies.append(Strategy(name, out_spec, cost,
+                                   (lhs_spec, rhs_spec)))
+    if not strategies:
+        strategies.append(Strategy("R", replicated_spec(out_ndim), 0.0,
+                                   (replicated_spec(len(lhs_av.shape)),
+                                    replicated_spec(len(rhs_av.shape)))))
+    return strategies
+
+
+def enumerate_reduce_strategies(eqn, logical_mesh) -> List[Strategy]:
+    """reduce_sum/reduce_max/...: strategies indexed by the operand spec;
+    sharded reduced dims pay an all-reduce on the output."""
+    mesh_shape = logical_mesh.shape
+    in_av = eqn.invars[0].aval
+    out_av = eqn.outvars[0].aval
+    red_dims = set(eqn.params.get("axes", ()))
+    kept = [d for d in range(len(in_av.shape)) if d not in red_dims]
+    strategies = []
+    for in_spec in enumerate_var_specs(in_av, mesh_shape):
+        out_map = {}
+        ar_axes = []
+        for d, axes in enumerate(in_spec):
+            if not axes:
+                continue
+            if d in red_dims:
+                ar_axes.extend(axes)
+            else:
+                out_map[kept.index(d)] = tuple(axes) if len(axes) > 1 \
+                    else axes[0]
+        out_spec = make_spec(len(out_av.shape), out_map)
+        if not spec_valid(out_av, out_spec, mesh_shape):
+            continue
+        out_bytes = (float(np.prod(out_av.shape) if out_av.shape else 1) *
+                     out_av.dtype.itemsize / num_shards(out_spec, mesh_shape))
+        # Reduction over sharded dims realizes as an all-reduce of the
+        # output for every reduction kind (sum/max/min/...).
+        cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
+                   for a in ar_axes)
+        strategies.append(Strategy(f"red{in_spec}", out_spec, cost,
+                                   (in_spec,)))
+    return strategies or [
+        Strategy("R", replicated_spec(len(out_av.shape)), 0.0,
+                 (replicated_spec(len(in_av.shape)),))
+    ]
+
+
+########################################
+# follow-through dim mappings
+########################################
+
+
+def follow_dimmap(eqn, operand_idx: int) -> Optional[DimMap]:
+    """If eqn's output can follow operand ``operand_idx``'s sharding via a
+    pure dim-mapping, return out_dim -> operand_dim, else None."""
+    prim = eqn.primitive.name
+    if not eqn.outvars or not hasattr(eqn.outvars[0], "aval"):
+        return None
+    out_shape = eqn.outvars[0].aval.shape
+    in_av = eqn.invars[operand_idx].aval if hasattr(
+        eqn.invars[operand_idx], "aval") else None
+    if in_av is None:
+        return None
+    in_shape = in_av.shape
+
+    if prim in ELEMENTWISE_PRIMS:
+        if in_shape == out_shape:
+            return identity_dimmap(len(out_shape))
+        # right-aligned broadcasting
+        if len(in_shape) <= len(out_shape):
+            off = len(out_shape) - len(in_shape)
+            dm = []
+            for d in range(len(out_shape)):
+                if d < off:
+                    dm.append(None)
+                else:
+                    ind = d - off
+                    dm.append(ind if in_shape[ind] == out_shape[d] else None)
+            return tuple(dm)
+        return None
+    if prim == "transpose":
+        perm = eqn.params["permutation"]
+        return tuple(perm)
+    if prim == "broadcast_in_dim":
+        bdims = eqn.params["broadcast_dimensions"]
+        inv = {od: id_ for id_, od in enumerate(bdims)}
+        dm = []
+        for d in range(len(out_shape)):
+            src = inv.get(d)
+            if src is not None and in_shape[src] == out_shape[d]:
+                dm.append(src)
+            else:
+                dm.append(None)
+        return tuple(dm)
+    if prim in ("reshape",):
+        # mappable iff the >1-sized dims correspond 1:1 in order
+        in_nt = [(d, s) for d, s in enumerate(in_shape) if s > 1]
+        out_nt = [(d, s) for d, s in enumerate(out_shape) if s > 1]
+        if [s for _, s in in_nt] != [s for _, s in out_nt]:
+            return None
+        dm = [None] * len(out_shape)
+        for (od, _), (id_, _) in zip(out_nt, in_nt):
+            dm[od] = id_
+        return tuple(dm)
+    if prim in ("squeeze",):
+        dims = set(eqn.params["dimensions"])
+        kept = [d for d in range(len(in_shape)) if d not in dims]
+        return tuple(kept)
+    if prim in ("expand_dims",):
+        dims = set(eqn.params["dimensions"])
+        dm = []
+        src = 0
+        for d in range(len(out_shape)):
+            if d in dims:
+                dm.append(None)
+            else:
+                dm.append(src)
+                src += 1
+        return tuple(dm)
+    if prim in ("rev", "cumsum", "cumprod", "cummax", "cummin",
+                "sort", "argsort"):
+        if in_shape == out_shape:
+            return identity_dimmap(len(out_shape))
+        return None
+    return None
+
+
+def pick_lead_operand(eqn) -> Optional[int]:
+    """Choose the operand to follow: the largest non-literal one."""
+    best, best_size = None, -1
+    for i, v in enumerate(eqn.invars):
+        if isinstance(v, Literal):
+            continue
+        if not hasattr(v, "aval") or not hasattr(v.aval, "shape"):
+            continue
+        size = int(np.prod(v.aval.shape)) if v.aval.shape else 1
+        if size > best_size:
+            best, best_size = i, size
+    return best
+
+
+########################################
+# graph construction
+########################################
+
+
+def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
+                         in_avals: Sequence[Any],
+                         logical_mesh,
+                         batch_flat_idx: Sequence[int],
+                         option) -> StrategyGraph:
+    jaxpr = closed_jaxpr.jaxpr
+    mesh_shape = logical_mesh.shape
+    nodes: List[Node] = []
+    edges: List[Edge] = []
+    # var -> (node_idx, dimmap var<-node)
+    var_node: Dict[Var, Tuple[int, DimMap]] = {}
+
+    def new_node(kind, aval, strategies, label="", invar_idx=None,
+                 outvar=None):
+        n = Node(len(nodes), kind, aval, strategies, label, invar_idx, outvar)
+        nodes.append(n)
+        return n
+
+    def barrier_node(aval, label):
+        nd = len(aval.shape) if hasattr(aval, "shape") else 0
+        return new_node("op", aval,
+                        [Strategy("R", replicated_spec(nd), 0.0)], label)
+
+    # --- invar nodes ---
+    batch_set = set(batch_flat_idx)
+    for i, (v, aval) in enumerate(zip(jaxpr.invars, in_avals)):
+        specs = enumerate_var_specs(aval, mesh_shape)
+        if i in batch_set and option.force_batch_dim_to_mesh_dim is not None:
+            a = option.force_batch_dim_to_mesh_dim
+            forced = make_spec(len(aval.shape), {0: a}) \
+                if len(aval.shape) and mesh_shape[a] > 1 else \
+                replicated_spec(len(aval.shape))
+            if spec_valid(aval, forced, mesh_shape):
+                specs = (forced,)
+        strategies = [Strategy(str(s), s, 0.0) for s in specs]
+        n = new_node("invar", aval, strategies, f"invar{i}", invar_idx=i)
+        var_node[v] = (n.idx, identity_dimmap(len(aval.shape)))
+
+    # constvars: replicated barriers
+    for v in jaxpr.constvars:
+        nd = len(v.aval.shape) if hasattr(v.aval, "shape") else 0
+        n = new_node("op", v.aval,
+                     [Strategy("R", replicated_spec(nd), 0.0)], "const")
+        var_node[v] = (n.idx, identity_dimmap(nd))
+
+    def edge_cost_matrix(src_node: Node, dimmap: DimMap, aval,
+                         required: List[Spec]) -> np.ndarray:
+        """cost[s_src, s_req] of delivering src's value (viewed through
+        dimmap) as each required operand spec."""
+        ndim = len(aval.shape) if hasattr(aval, "shape") else 0
+        C = np.zeros((len(src_node.strategies), len(required)))
+        for si, st in enumerate(src_node.strategies):
+            mapped, dropped = map_spec(st.out_spec, dimmap, ndim)
+            size_bytes = (float(np.prod(aval.shape) if aval.shape else 1) *
+                          aval.dtype.itemsize)
+            drop_cost = sum(logical_mesh.all_gather_cost(size_bytes, a)
+                            for a in dropped)
+            for ri, req in enumerate(required):
+                C[si, ri] = drop_cost + resharding_cost(
+                    aval, mapped, req, logical_mesh)
+        return C
+
+    def get_source(v):
+        """Node+dimmap for a var, creating a replicated barrier for unknown
+        sources (e.g. scan outputs)."""
+        if isinstance(v, Literal):
+            return None
+        if v not in var_node:
+            n = barrier_node(v.aval, "opaque")
+            var_node[v] = (n.idx, identity_dimmap(
+                len(v.aval.shape) if hasattr(v.aval, "shape") else 0))
+        return var_node[v]
+
+    flat_eqns = flatten_jaxpr_eqns(jaxpr)
+
+    for eqn in flat_eqns:
+        prim = eqn.primitive.name
+
+        if prim == "pipeline":  # markers: identity pass-through
+            for iv, ov in zip(eqn.invars, eqn.outvars):
+                if isinstance(iv, Literal):
+                    continue
+                src = get_source(iv)
+                if src is not None:
+                    var_node[ov] = src
+            continue
+
+        if prim == "dot_general":
+            strategies = enumerate_dot_strategies(eqn, logical_mesh)
+            out_av = eqn.outvars[0].aval
+            n = new_node("op", out_av, strategies, f"dot:{out_av.shape}",
+                         outvar=eqn.outvars[0])
+            for oi in range(2):
+                v = eqn.invars[oi]
+                src = get_source(v)
+                if src is None:
+                    continue
+                src_idx, dimmap = src
+                req = [st.operand_specs[oi] for st in strategies]
+                C = edge_cost_matrix(nodes[src_idx], dimmap, v.aval, req)
+                edges.append(Edge(src_idx, n.idx, C))
+            var_node[eqn.outvars[0]] = (n.idx,
+                                        identity_dimmap(len(out_av.shape)))
+            continue
+
+        if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                    "reduce_and", "reduce_or", "argmax", "argmin"):
+            strategies = enumerate_reduce_strategies(eqn, logical_mesh)
+            out_av = eqn.outvars[0].aval
+            n = new_node("op", out_av, strategies, f"{prim}", outvar=None)
+            v = eqn.invars[0]
+            src = get_source(v)
+            if src is not None:
+                src_idx, dimmap = src
+                req = [st.operand_specs[0] for st in strategies]
+                C = edge_cost_matrix(nodes[src_idx], dimmap, v.aval, req)
+                edges.append(Edge(src_idx, n.idx, C))
+            var_node[eqn.outvars[0]] = (n.idx,
+                                        identity_dimmap(len(out_av.shape)))
+            continue
+
+        # Free nodes: ops whose inputs are all literals/scalars (constant
+        # broadcasts, iota, zeros_like chains).  Materializing any sharding
+        # of them is free, so they get the full spec space at zero cost and
+        # the ILP aligns them with their consumers via consistency edges.
+        def _scalar_or_lit(v):
+            if isinstance(v, Literal):
+                return True
+            if not hasattr(v, "aval") or not hasattr(v.aval, "shape"):
+                return True
+            return (int(np.prod(v.aval.shape)) if v.aval.shape else 1) == 1
+
+        if (eqn.outvars and hasattr(eqn.outvars[0], "aval") and
+                getattr(eqn.outvars[0].aval, "shape", None) and
+                all(_scalar_or_lit(v) for v in eqn.invars)):
+            out_av = eqn.outvars[0].aval
+            specs = enumerate_var_specs(out_av, mesh_shape)
+            n = new_node("op", out_av,
+                         [Strategy(str(s), s, 0.0) for s in specs],
+                         f"free:{prim}")
+            var_node[eqn.outvars[0]] = (n.idx,
+                                        identity_dimmap(len(out_av.shape)))
+            for ov in eqn.outvars[1:]:
+                if hasattr(ov, "aval") and hasattr(ov.aval, "shape"):
+                    bn = barrier_node(ov.aval, f"barrier:{prim}")
+                    var_node[ov] = (bn.idx,
+                                    identity_dimmap(len(ov.aval.shape)))
+            continue
+
+        # follow-through attempt
+        lead = pick_lead_operand(eqn)
+        dm = follow_dimmap(eqn, lead) if lead is not None else None
+        if dm is not None:
+            src = get_source(eqn.invars[lead])
+            if src is not None:
+                src_idx, src_dm = src
+                composed = compose_dimmap(dm, src_dm)
+                var_node[eqn.outvars[0]] = (src_idx, composed)
+                # Side operands (bias adds, residual joins): they must match
+                # the followed spec on their (right-aligned broadcast) dims.
+                # Add a consistency edge (side node <-> lead node) whose cost
+                # is the resharding of the *side* tensor to the lead's spec
+                # viewed in output-dim space.
+                out_ndim = len(eqn.outvars[0].aval.shape)
+                lead_av = eqn.invars[lead].aval
+                lead_size = float(np.prod(lead_av.shape) or 1)
+                for oi, v in enumerate(eqn.invars):
+                    if oi == lead or isinstance(v, Literal):
+                        continue
+                    if not (hasattr(v, "aval") and hasattr(v.aval, "shape")):
+                        continue
+                    side_size = float(np.prod(v.aval.shape) or 1)
+                    if side_size * 8 < lead_size:
+                        # small operands (biases, scalars): GSPMD replicates
+                        # or reshards them cheaply; ignore in the model.
+                        continue
+                    osrc = get_source(v)
+                    if osrc is None:
+                        continue
+                    o_idx, o_dm = osrc
+                    if o_idx == src_idx:
+                        continue
+                    side_dm = follow_dimmap(eqn, oi)
+                    if side_dm is None:
+                        side_dm = (None,) * out_ndim
+                    o_comp = compose_dimmap(side_dm, o_dm)
+                    src_node_, o_node_ = nodes[src_idx], nodes[o_idx]
+                    C = np.zeros((len(o_node_.strategies),
+                                  len(src_node_.strategies)))
+                    for si, st_o in enumerate(o_node_.strategies):
+                        o_spec, o_drop = map_spec(st_o.out_spec, o_comp,
+                                                  out_ndim)
+                        sb = side_size * v.aval.dtype.itemsize
+                        drop_cost = sum(
+                            logical_mesh.all_gather_cost(sb, a)
+                            for a in o_drop)
+                        for li, st_l in enumerate(src_node_.strategies):
+                            l_spec, _ = map_spec(st_l.out_spec, composed,
+                                                 out_ndim)
+                            C[si, li] = drop_cost + resharding_cost(
+                                v.aval if len(v.aval.shape) == out_ndim
+                                else eqn.outvars[0].aval,
+                                o_spec, l_spec, logical_mesh)
+                    edges.append(Edge(o_idx, src_idx, C))
+                continue
+
+        # barrier: unknown op -> replicated node per output
+        for ov in eqn.outvars:
+            if hasattr(ov, "aval") and hasattr(ov.aval, "shape"):
+                n = barrier_node(ov.aval, f"barrier:{prim}")
+                var_node[ov] = (n.idx, identity_dimmap(len(ov.aval.shape)))
+                # charge gathering of inputs into the barrier
+                for v in eqn.invars:
+                    if isinstance(v, Literal) or not hasattr(v, "aval"):
+                        continue
+                    if not hasattr(v.aval, "shape"):
+                        continue
+                    src = get_source(v)
+                    if src is None:
+                        continue
+                    src_idx, dimmap = src
+                    req = [replicated_spec(len(v.aval.shape))]
+                    C = edge_cost_matrix(nodes[src_idx], dimmap, v.aval, req)
+                    edges.append(Edge(src_idx, n.idx, C))
+
+    return StrategyGraph(nodes, edges, logical_mesh)

@@ -1,0 +1,105 @@
+"""Benchmark driver: GPT train-step throughput on the available chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline anchor (BASELINE.md): the reference's published manual-3D GPT-2.6B
+result of 37.01 TFLOPS/GPU on 8x V100 (ref benchmark/alpa/README.md:89-101).
+vs_baseline = achieved TFLOPS-per-chip / 37.01.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_TFLOPS_PER_DEVICE = 37.01
+
+
+def main():
+    import optax
+
+    import alpa_tpu
+    from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
+    from alpa_tpu.model.model_util import cross_entropy_loss
+    from alpa_tpu.util import compute_gpt_tflops
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform in ("tpu", "axon")
+    n_dev = len(devices)
+
+    if on_tpu:
+        # GPT-125M-class config in bf16; batch sized for one v5e chip.
+        config = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                           seq_len=1024, vocab_size=51200, dtype=jnp.bfloat16)
+        batch_size = 8
+    else:
+        config = GPTConfig(hidden_size=256, num_layers=4, num_heads=8,
+                           seq_len=256, vocab_size=1024, dtype=jnp.float32)
+        batch_size = 8
+
+    alpa_tpu.init(cluster="local")
+    model = GPTModel(config)
+    rng = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rng, (batch_size, config.seq_len), 0,
+                                   config.vocab_size)
+    labels = jax.random.randint(rng, (batch_size, config.seq_len), 0,
+                                config.vocab_size)
+    params = model.init(rng, input_ids)
+    tx = optax.adam(1e-4)
+    from flax.training import train_state
+    state = train_state.TrainState.create(apply_fn=model.apply, params=params,
+                                          tx=tx)
+
+    @alpa_tpu.parallelize(method=alpa_tpu.ShardParallel(),
+                          donate_argnums=(0,))
+    def train_step(state, batch):
+
+        def loss_fn(p):
+            logits = state.apply_fn(p, batch["input_ids"])
+            return cross_entropy_loss(logits.astype(jnp.float32),
+                                      batch["labels"])
+
+        loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    batch = {"input_ids": input_ids, "labels": labels}
+
+    # Warmup: first call compiles; the next two absorb one-time runtime
+    # warmup (executable load / transfer setup on remote-attached chips).
+    for _ in range(3):
+        state, loss = train_step(state, batch)
+        float(loss)  # force full completion
+
+    n_iter = 10
+    tic = time.perf_counter()
+    for _ in range(n_iter):
+        state, loss = train_step(state, batch)
+    float(loss)  # drains the on-device queue
+    latency = (time.perf_counter() - tic) / n_iter
+
+    tokens_per_sec = batch_size * config.seq_len / latency
+    tflops = compute_gpt_tflops(batch_size, config.seq_len, config.num_layers,
+                                config.hidden_size, config.vocab_size, n_dev,
+                                latency)
+    print(json.dumps({
+        "metric": "gpt_train_tflops_per_chip",
+        "value": round(tflops, 3),
+        "unit": "TFLOPS/chip",
+        "vs_baseline": round(tflops / BASELINE_TFLOPS_PER_DEVICE, 4),
+        "detail": {
+            "model": f"h{config.hidden_size}-l{config.num_layers}",
+            "batch": batch_size,
+            "seq": config.seq_len,
+            "latency_s": round(latency, 5),
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "n_devices": n_dev,
+            "platform": devices[0].platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()
