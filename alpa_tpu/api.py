@@ -206,6 +206,22 @@ def parallelize(fun: Optional[Callable] = None,
     return decorate(fun)
 
 
+def _maybe_layer_transform(fun):
+    """Apply the active pipeline layer transform to a loss function.
+
+    The pipeline compile driver installs a LayerOption context while
+    tracing (ref: the reference applies manual/automatic_layer_construction
+    decorators to the loss fn); here ``alpa_tpu.grad`` picks it up so users
+    don't decorate the loss function themselves.
+    """
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        current_layer_option, layer_level_transform)
+    opt = current_layer_option()
+    if opt is None:
+        return fun
+    return layer_level_transform(fun, opt)
+
+
 def grad(fun, *args, **kwargs):
     """``jax.grad`` + gradient boundary marker (ref api.py:241).
 
@@ -213,10 +229,10 @@ def grad(fun, *args, **kwargs):
     gradient accumulation and pipeline compilation can split compute_grad
     from apply_grad at the marker.
     """
-    jax_grad = jax.grad(fun, *args, **kwargs)
 
-    @functools.wraps(jax_grad)
+    @functools.wraps(fun)
     def wrapped(*call_args, **call_kwargs):
+        jax_grad = jax.grad(_maybe_layer_transform(fun), *args, **kwargs)
         return mark_gradient(jax_grad(*call_args, **call_kwargs))
 
     return wrapped
@@ -224,10 +240,11 @@ def grad(fun, *args, **kwargs):
 
 def value_and_grad(fun, *args, **kwargs):
     """``jax.value_and_grad`` + gradient marker (ref api.py:265)."""
-    jax_vg = jax.value_and_grad(fun, *args, **kwargs)
 
-    @functools.wraps(jax_vg)
+    @functools.wraps(fun)
     def wrapped(*call_args, **call_kwargs):
+        jax_vg = jax.value_and_grad(_maybe_layer_transform(fun), *args,
+                                    **kwargs)
         val, grads = jax_vg(*call_args, **call_kwargs)
         return mark_gradient((val, grads))
 

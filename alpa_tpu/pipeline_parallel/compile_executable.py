@@ -1,0 +1,321 @@
+"""Pipeshard compilation: the flagship inter+intra-op compile path.
+
+Analog of ref ``compile_pipeshard_executable``
+(``alpa/pipeline_parallel/compile_executable.py:48``; call stack SURVEY.md
+§3.3):
+
+  trace (layer-marked, microbatch avals)
+  -> split at gradient marker (apply_grad.py)
+  -> slice into layer computations (computation.py)
+  -> cluster layers into stages + slice the cluster into submeshes
+     (stage_construction.py)
+  -> rewrite backward stages to accumulate gradients
+  -> partition apply_grad across meshes
+  -> intra-op plan + jit-compile every stage on its submesh
+     (shard_parallel planner)
+  -> generate schedule (schedules.py) and emit the static instruction list
+     (runtime_emitter.py)
+  -> PipeshardDriverExecutable (pipeshard_executable.py)
+"""
+import itertools
+import logging
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.extend.core import ClosedJaxpr, Literal, Var
+
+from alpa_tpu.device_mesh import VirtualPhysicalMesh
+from alpa_tpu.global_env import global_config
+from alpa_tpu.pipeline_parallel.apply_grad import (
+    apply_grad_get_mean, apply_partition_is_acyclic,
+    compute_grad_to_accumulate_grad, partition_apply_grad,
+    split_compute_grad_and_apply_grad)
+from alpa_tpu.pipeline_parallel.computation import (
+    JaxPipelineComputation,
+    mark_missing_vars_in_backward_computation_pipeline_marks, merge_computations,
+    pipeline_dce, slice_closed_jaxpr_by_full_pipeline_marks)
+from alpa_tpu.pipeline_parallel.layer_construction import (
+    AutoLayerOption, LayerOption, ManualLayerOption, set_current_layer_option)
+from alpa_tpu.pipeline_parallel.schedules import create_pipeline_schedule
+from alpa_tpu.pipeline_parallel.stage_construction import (
+    StageOption, cluster_layers_and_slice_mesh)
+from alpa_tpu.util import OrderedSet, clone_jaxpr
+
+logger = logging.getLogger(__name__)
+
+
+def _layer_index_of(name: str) -> Optional[int]:
+    m = re.search(r"layer_(\d+)", name)
+    return int(m.group(1)) if m else None
+
+
+def _is_backward_name(name: str) -> bool:
+    return "backward" in name
+
+
+def compile_pipeshard_executable(fun: Callable,
+                                 virtual_mesh: VirtualPhysicalMesh,
+                                 in_avals: Sequence[Any],
+                                 in_tree,
+                                 in_paths: Sequence[str],
+                                 donated_invars: Sequence[bool],
+                                 batch_invars: Sequence[bool],
+                                 num_micro_batches: int,
+                                 as_option,
+                                 pipeline_schedule: str,
+                                 layer_option: Optional[LayerOption],
+                                 stage_option: Optional[StageOption]):
+    from alpa_tpu.pipeline_parallel.pipeshard_executable import (
+        PipeshardDriverExecutable)
+
+    tic = time.time()
+    num_micro_batches = num_micro_batches or 1
+    layer_option = layer_option or AutoLayerOption(
+        layer_num=min(8, virtual_mesh.num_hosts if virtual_mesh.num_hosts > 1
+                      else virtual_mesh.num_devices))
+
+    # ---- trace at microbatch avals with the layer transform active ----
+    batch_flat_idx = [i for i, b in enumerate(batch_invars) if b]
+    micro_avals = list(in_avals)
+    for i in batch_flat_idx:
+        a = in_avals[i]
+        b = a.shape[0]
+        assert b % num_micro_batches == 0, (
+            f"batch size {b} not divisible by num_micro_batches="
+            f"{num_micro_batches}")
+        micro_avals[i] = jax.ShapeDtypeStruct(
+            (b // num_micro_batches,) + tuple(a.shape[1:]), a.dtype)
+
+    set_current_layer_option(layer_option)
+    try:
+        # Fresh closure: jax caches traces by (fun object, avals); the layer
+        # transform changes tracing behavior via context, so a cached
+        # marker-free trace (e.g. from donation inference) must not be hit.
+        closed_jaxpr = jax.make_jaxpr(lambda *a: fun(*a))(*micro_avals)
+    finally:
+        set_current_layer_option(None)
+
+    global_invars = list(closed_jaxpr.jaxpr.invars)
+    global_outvars = list(closed_jaxpr.jaxpr.outvars)
+    consts_map = dict(zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts))
+
+    inference_mode = not any(
+        _has_grad_marker(e) for e in closed_jaxpr.jaxpr.eqns)
+
+    if inference_mode:
+        return _compile_inference(fun, virtual_mesh, closed_jaxpr, in_avals,
+                                  micro_avals, in_tree, batch_invars,
+                                  num_micro_batches, as_option,
+                                  stage_option, tic)
+
+    # ---- split at the gradient marker ----
+    compute_eqns, grad_pairs, apply_eqns = \
+        split_compute_grad_and_apply_grad(closed_jaxpr)
+    compute_jaxpr = clone_jaxpr(closed_jaxpr, eqns=compute_eqns,
+                                outvars=[p for p, _ in grad_pairs])
+
+    # ---- slice into layer computations ----
+    computations, _meta = slice_closed_jaxpr_by_full_pipeline_marks(
+        compute_jaxpr)
+    if not computations:
+        raise ValueError(
+            "No pipeline layers found: use ManualLayerOption with "
+            "mark_pipeline_boundary() or AutoLayerOption.")
+    computations = \
+        mark_missing_vars_in_backward_computation_pipeline_marks(
+            computations, global_invars)
+    computations = pipeline_dce(computations, compute_jaxpr.jaxpr.outvars)
+
+    # classify forward/backward and group by layer
+    fwd_comps, bwd_comps = [], []
+    for comp in computations:
+        (bwd_comps if _is_backward_name(comp.name) else
+         fwd_comps).append(comp)
+    num_layers = len(fwd_comps)
+    assert num_layers > 0, "no forward layers"
+
+    # backward comp for forward layer i (may be missing for layers with no
+    # params, rare) — match by layer index
+    bwd_by_layer: Dict[int, List[JaxPipelineComputation]] = {}
+    for comp in bwd_comps:
+        li = _layer_index_of(comp.name)
+        bwd_by_layer.setdefault(li if li is not None else num_layers - 1,
+                                []).append(comp)
+
+    # ---- cluster layers into stages + slice mesh ----
+    fwd_stage_layer_ids, submeshes, logical_shapes, as_dicts = \
+        cluster_layers_and_slice_mesh(
+            num_layers, virtual_mesh, stage_option,
+            num_micro_batches=num_micro_batches,
+            layer_comps=fwd_comps,
+            auto_sharding_option=as_option)
+    num_stages = len(fwd_stage_layer_ids)
+
+    # merge layer computations into stage computations
+    fwd_stages: List[JaxPipelineComputation] = []
+    bwd_stages: List[JaxPipelineComputation] = []
+    for s, layer_ids in enumerate(fwd_stage_layer_ids):
+        fwd_stages.append(
+            merge_computations([fwd_comps[i] for i in layer_ids],
+                               f"stage_{s}_fwd"))
+        bwd_list = [
+            c for i in reversed(layer_ids) for c in bwd_by_layer.get(i, [])
+        ]
+        bwd_stages.append(
+            merge_computations(bwd_list, f"stage_{s}_bwd")
+            if bwd_list else JaxPipelineComputation(
+                f"stage_{s}_bwd", [], [], []))
+
+    # ---- gradient accumulation rewrite ----
+    all_stages = fwd_stages + bwd_stages
+    # ensure every grad pre-var is exported by some stage
+    _export_vars(all_stages, [p for p, _ in grad_pairs])
+    all_stages, acc_info = compute_grad_to_accumulate_grad(
+        all_stages, [p for p, _ in grad_pairs])
+
+    # ---- apply-grad processing ----
+    apply_eqns, mean_sub = apply_grad_get_mean(apply_eqns, grad_pairs,
+                                               num_micro_batches)
+    # Global outputs that are marked values directly (e.g. the returned
+    # loss) must read the microbatch-mean, not the raw accumulated sum.
+    global_outvars = [
+        mean_sub.get(v, v) if isinstance(v, Var) else v
+        for v in global_outvars
+    ]
+    # var -> mesh placement seeds
+    var_mesh: Dict[Var, int] = {}
+    for pre, post in grad_pairs:
+        if pre in acc_info:
+            _, _, comp_idx = acc_info[pre]
+            # acc_info indexes into fwd_stages + bwd_stages, where
+            # bwd_stages[m] runs on mesh m (layers already reversed).
+            mesh_id = comp_idx if comp_idx < num_stages else \
+                comp_idx - num_stages
+            var_mesh[post] = mesh_id
+    # params used by forward stage s -> mesh s
+    ginvar_set = set(global_invars)
+    for s, comp in enumerate(fwd_stages):
+        for v in comp.invars:
+            if v in ginvar_set:
+                var_mesh.setdefault(v, s)
+    for s, comp in enumerate(bwd_stages):
+        for v in comp.invars:
+            if v in ginvar_set:
+                var_mesh.setdefault(v, s)
+
+    apply_comps, apply_var_mesh = partition_apply_grad(
+        apply_eqns, var_mesh, num_stages, global_outvars, consts_map)
+    if not apply_partition_is_acyclic(apply_comps):
+        # Mutual cross-mesh dependence (e.g. global-norm clipping reads all
+        # grads and feeds scaled grads back to every mesh): fall back to a
+        # single-mesh apply; gradients are resharded to mesh 0.
+        logger.warning(
+            "apply_grad partition is cyclic (global cross-gradient op?); "
+            "running the whole apply_grad on mesh 0")
+        apply_comps, apply_var_mesh = partition_apply_grad(
+            apply_eqns, var_mesh, num_stages, global_outvars, consts_map,
+            force_mesh=0)
+
+    if global_config.print_compilation_time:
+        logger.warning("pipeshard front-end took %.2f s", time.time() - tic)
+
+    return PipeshardDriverExecutable(
+        virtual_mesh=virtual_mesh,
+        fwd_stages=fwd_stages,
+        bwd_stages=bwd_stages,
+        apply_comps=apply_comps,
+        submeshes=submeshes,
+        logical_shapes=logical_shapes,
+        as_dicts=as_dicts,
+        as_option=as_option,
+        schedule_name=pipeline_schedule,
+        num_micro_batches=num_micro_batches,
+        global_invars=global_invars,
+        global_outvars=global_outvars,
+        batch_invars=batch_invars,
+        donated_invars=donated_invars,
+        grad_pairs=grad_pairs,
+        acc_info=acc_info,
+        in_avals=in_avals,
+        micro_avals=micro_avals,
+        consts_map=consts_map,
+        apply_var_mesh=apply_var_mesh,
+    )
+
+
+def _has_grad_marker(eqn) -> bool:
+    from alpa_tpu.pipeline_parallel.primitive_def import is_marker
+    return is_marker(eqn, "grad")
+
+
+def _export_vars(stages: List[JaxPipelineComputation], needed: Sequence[Var]):
+    """Make sure each needed var is an outvar of the stage defining it."""
+    for v in needed:
+        found = any(v in s.outvars for s in stages)
+        if found:
+            continue
+        for s in stages:
+            if any(v in e.outvars for e in s.eqns):
+                s.outvars.append(v)
+                break
+
+
+def _compile_inference(fun, virtual_mesh, closed_jaxpr, in_avals,
+                       micro_avals, in_tree, batch_invars,
+                       num_micro_batches, as_option, stage_option, tic):
+    """Forward-only pipeshard compile (inference schedule)."""
+    from alpa_tpu.pipeline_parallel.pipeshard_executable import (
+        PipeshardDriverExecutable)
+
+    global_invars = list(closed_jaxpr.jaxpr.invars)
+    global_outvars = list(closed_jaxpr.jaxpr.outvars)
+    consts_map = dict(zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts))
+
+    computations, _ = slice_closed_jaxpr_by_full_pipeline_marks(closed_jaxpr)
+    if not computations:
+        raise ValueError(
+            "No pipeline layers found. For training, use alpa_tpu.grad / "
+            "value_and_grad (plain jax.grad hides the gradient boundary "
+            "and disables the layer transform); for inference, mark layers "
+            "with mark_pipeline_boundary() or use AutoLayerOption.")
+    computations = \
+        mark_missing_vars_in_backward_computation_pipeline_marks(
+            computations, global_invars)
+    computations = pipeline_dce(computations, global_outvars)
+
+    num_layers = len(computations)
+    fwd_stage_layer_ids, submeshes, logical_shapes, as_dicts = \
+        cluster_layers_and_slice_mesh(
+            num_layers, virtual_mesh, stage_option,
+            num_micro_batches=num_micro_batches,
+            layer_comps=computations, auto_sharding_option=as_option)
+    fwd_stages = [
+        merge_computations([computations[i] for i in ids], f"stage_{s}_fwd")
+        for s, ids in enumerate(fwd_stage_layer_ids)
+    ]
+
+    return PipeshardDriverExecutable(
+        virtual_mesh=virtual_mesh,
+        fwd_stages=fwd_stages,
+        bwd_stages=[],
+        apply_comps=[],
+        submeshes=submeshes,
+        logical_shapes=logical_shapes,
+        as_dicts=as_dicts,
+        as_option=as_option,
+        schedule_name="inference",
+        num_micro_batches=num_micro_batches,
+        global_invars=global_invars,
+        global_outvars=global_outvars,
+        batch_invars=batch_invars,
+        donated_invars=(False,) * len(in_avals),
+        grad_pairs=[],
+        acc_info={},
+        in_avals=in_avals,
+        micro_avals=micro_avals,
+        consts_map=consts_map,
+        apply_var_mesh={},
+    )

@@ -1,0 +1,253 @@
+"""Pipeline stage IRs and jaxpr slicing.
+
+Analog of ref ``alpa/pipeline_parallel/computation.py`` (SURVEY.md §2.4):
+``JaxPipelineComputation`` (a named jaxpr fragment with explicit
+invars/outvars), slicing a fully-marked jaxpr into computations
+(``slice_closed_jaxpr_by_full_pipeline_marks:387``), filling backward-layer
+missing vars (``:433``), dead code elimination across computations
+(``pipeline_dce:574``), and merging computations
+(``merge_computation_jaxprs:911``).
+"""
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+from jax._src.core import jaxpr_as_fun
+from jax.extend.core import ClosedJaxpr, Jaxpr, Literal, Var
+
+from alpa_tpu.pipeline_parallel.primitive_def import (is_marker,
+                                                      is_pipeline_eqn,
+                                                      pipeline_p)
+from alpa_tpu.util import OrderedSet, clone_jaxpr, new_jaxpr_eqn
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class JaxPipelineComputation:
+    """One pipeline layer/stage as a jaxpr fragment (ref computation.py:84).
+
+    ``eqns`` excludes the start/end markers; ``invars``/``outvars`` are the
+    *outer* variables crossing the markers.
+    """
+    name: str
+    invars: List[Var]
+    outvars: List[Var]
+    eqns: List[Any]
+    consts_dir: Dict[Var, Any] = dataclasses.field(default_factory=dict)
+
+    def closed_jaxpr(self) -> ClosedJaxpr:
+        jaxpr = Jaxpr(
+            constvars=list(self.consts_dir.keys()),
+            invars=self.invars,
+            outvars=self.outvars,
+            eqns=self.eqns,
+        )
+        return ClosedJaxpr(jaxpr, list(self.consts_dir.values()))
+
+    def get_runnable(self):
+        return jaxpr_as_fun(self.closed_jaxpr())
+
+    @property
+    def avals_in(self):
+        return [v.aval for v in self.invars]
+
+    @property
+    def avals_out(self):
+        return [v.aval for v in self.outvars]
+
+
+def slice_closed_jaxpr_by_full_pipeline_marks(
+        closed_jaxpr: ClosedJaxpr,
+        strict: bool = True
+) -> Tuple[List[JaxPipelineComputation], Dict]:
+    """Slice a marked jaxpr into computations (ref computation.py:387).
+
+    Marker protocol: a start marker maps outer vars -> layer-local vars; an
+    end marker maps layer-local vars -> outer vars.  Eqns between markers
+    use layer-local vars.  Eqns outside any marker pair (e.g. glue between
+    backward layers) are attached to the *following* computation, keeping
+    the eqn order valid.
+    """
+    consts_map = dict(zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts))
+
+    # ---- pass 1: global marker alias map (local -> outer) ----
+    # A start marker maps outer -> local; an end marker maps local -> outer.
+    # Residuals saved by autodiff reference a *local* var of the forward
+    # layer from inside the backward layer, so the substitution must be
+    # global, not per-computation.
+    alias: Dict[Var, Any] = {}
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        if is_marker(eqn, "start"):
+            for outer, local in zip(eqn.invars, eqn.outvars):
+                alias[local] = outer
+        elif is_marker(eqn, "end"):
+            for local, outer in zip(eqn.invars, eqn.outvars):
+                if isinstance(local, Var):
+                    alias[local] = outer
+
+    def resolve(v):
+        if isinstance(v, Literal):
+            return v
+        seen = 0
+        while isinstance(v, Var) and v in alias and seen < 100:
+            v = alias[v]
+            seen += 1
+        return v
+
+    computations: List[JaxPipelineComputation] = []
+    current = None
+    floating_eqns: List[Any] = []  # eqns outside any marker pair
+
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        if is_marker(eqn, "start"):
+            assert current is None, "nested pipeline markers"
+            current = JaxPipelineComputation(
+                name=eqn.params["name"],
+                invars=[resolve(v) for v in eqn.invars
+                        if isinstance(resolve(v), Var)],
+                outvars=[],
+                eqns=list(floating_eqns))
+            floating_eqns = []
+            continue
+        if is_marker(eqn, "end"):
+            assert current is not None, "end marker without start"
+            current.outvars = [
+                resolve(v) for v in eqn.outvars
+                if isinstance(resolve(v), Var)
+            ]
+            computations.append(current)
+            current = None
+            continue
+        if is_pipeline_eqn(eqn):
+            # stray markers (grad/boundary/jvp copies): identity glue
+            target = current.eqns if current is not None else floating_eqns
+            for iv, ov in zip(eqn.invars, eqn.outvars):
+                riv, rov = resolve(iv), resolve(ov)
+                if isinstance(rov, Var) and riv is not rov:
+                    target.append(_identity_eqn(riv, rov))
+            continue
+        target = current.eqns if current is not None else floating_eqns
+        target.append(
+            eqn.replace(invars=[resolve(v) for v in eqn.invars],
+                        outvars=[resolve(v) for v in eqn.outvars]))
+
+    if floating_eqns and computations:
+        if strict:
+            computations[-1].eqns.extend(floating_eqns)
+            floating_eqns = []
+
+    # collect consts used per computation
+    for comp in computations:
+        for e in comp.eqns:
+            for v in e.invars:
+                if isinstance(v, Var) and v in consts_map:
+                    comp.consts_dir[v] = consts_map[v]
+
+    meta = {"floating_eqns": floating_eqns, "alias": alias}
+    return computations, meta
+
+
+def _identity_eqn(invar, outvar):
+    from jax.extend.core import Primitive
+    return new_jaxpr_eqn([invar], [outvar], pipeline_p,
+                         dict(name="copy", mark_type="jvp"))
+
+
+def mark_missing_vars_in_backward_computation_pipeline_marks(
+        computations: List[JaxPipelineComputation],
+        global_invars: Sequence[Var]) -> List[JaxPipelineComputation]:
+    """Backward computations may consume forward intermediates that never
+    passed through markers (residuals); add them to invars
+    (ref computation.py:433)."""
+    defined_by = {}
+    for ci, comp in enumerate(computations):
+        for e in comp.eqns:
+            for v in e.outvars:
+                defined_by[v] = ci
+    global_set = set(global_invars)
+    for ci, comp in enumerate(computations):
+        known = OrderedSet(comp.invars)
+        defined_here = OrderedSet()
+        for e in comp.eqns:
+            defined_here.update([v for v in e.outvars])
+        for e in comp.eqns:
+            for v in e.invars:
+                if (isinstance(v, Var) and v not in known and
+                        v not in defined_here and v not in comp.consts_dir):
+                    comp.invars.append(v)
+                    known.add(v)
+                    # also export it from its producer
+                    src = defined_by.get(v)
+                    if src is not None and src != ci and \
+                            v not in computations[src].outvars:
+                        computations[src].outvars.append(v)
+    return computations
+
+
+def pipeline_dce(computations: List[JaxPipelineComputation],
+                 global_outvars: Sequence[Var]
+                 ) -> List[JaxPipelineComputation]:
+    """Remove dead eqns/outvars across computations (ref computation.py:574).
+
+    Walk computations in reverse: a computation's live outvars are those
+    used by later computations or the global outputs; DCE its eqns against
+    them; its remaining invars feed the liveness of earlier computations.
+    """
+    live = OrderedSet([v for v in global_outvars if isinstance(v, Var)])
+    for comp in reversed(computations):
+        comp.outvars = [v for v in comp.outvars if v in live]
+        # values defined here that are globally live but never passed
+        # through a marker (e.g. tied-parameter gradient sums living in
+        # inter-layer glue) must be kept and exported
+        defined_here = OrderedSet()
+        for e in comp.eqns:
+            defined_here.update(e.outvars)
+        for v in live:
+            if v in defined_here and v not in comp.outvars:
+                comp.outvars.append(v)
+        live_local = OrderedSet(comp.outvars)
+        new_eqns = []
+        for e in reversed(comp.eqns):
+            if any(v in live_local for v in e.outvars) or _has_effects(e):
+                new_eqns.append(e)
+                for v in e.invars:
+                    if isinstance(v, Var):
+                        live_local.add(v)
+        comp.eqns = list(reversed(new_eqns))
+        comp.invars = [v for v in comp.invars if v in live_local]
+        comp.consts_dir = {
+            v: c for v, c in comp.consts_dir.items() if v in live_local
+        }
+        live.update(comp.invars)
+    return [c for c in computations if c.eqns or c.outvars]
+
+
+def _has_effects(eqn) -> bool:
+    try:
+        return bool(eqn.effects)
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def merge_computations(computations: List[JaxPipelineComputation],
+                       name: str) -> JaxPipelineComputation:
+    """Concatenate computations into one (ref merge_computation_jaxprs:911)."""
+    invars = OrderedSet()
+    defined = OrderedSet()
+    eqns = []
+    consts = {}
+    for comp in computations:
+        for v in comp.invars:
+            if v not in defined:
+                invars.add(v)
+        eqns.extend(comp.eqns)
+        for e in comp.eqns:
+            defined.update(e.outvars)
+        consts.update(comp.consts_dir)
+    outvars = OrderedSet()
+    for comp in computations:
+        outvars.update(comp.outvars)
+    return JaxPipelineComputation(name, list(invars), list(outvars), eqns,
+                                  consts)

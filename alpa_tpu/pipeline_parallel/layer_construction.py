@@ -1,0 +1,354 @@
+"""Layer construction: cluster a loss function's jaxpr into pipeline layers.
+
+Analog of ref ``alpa/pipeline_parallel/layer_construction.py`` (SURVEY.md
+§2.4): group equations into K layers either at user-placed boundary markers
+(``ManualLayerOption``) or automatically by a DP minimizing max per-layer
+flops + cross-layer communication (``AutoLayerOption``, ref
+``cluster_jaxpr_by_cost:342``), then wrap every layer in full start/end
+pipeline markers (so autodiff transposes them into backward-layer markers)
+and optionally apply per-layer rematerialization (ref ``manual_remat:542``,
+``automatic_remat:571``).
+
+The transform applies to the *loss function* before differentiation:
+``alpa_tpu.grad`` consults the active layer option
+(``set_current_layer_option``) installed by the pipeline compile driver.
+"""
+import dataclasses
+import logging
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax._src.core import jaxpr_as_fun
+from jax.extend.core import ClosedJaxpr, Literal, Var
+
+from alpa_tpu.pipeline_parallel.primitive_def import pipeline_p
+from alpa_tpu.util import (OrderedSet, clone_jaxpr, jaxpr_eqn_flops,
+                           new_jaxpr_eqn)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LayerOption:
+    """Base layer option (ref layer_construction.py:35)."""
+    remat_layer: bool = False
+
+
+@dataclasses.dataclass
+class ManualLayerOption(LayerOption):
+    """Split at user-placed ``mark_pipeline_boundary()`` calls
+    (ref layer_construction.py:46)."""
+
+
+@dataclasses.dataclass
+class AutoLayerOption(LayerOption):
+    """Automatic clustering into ``layer_num`` layers
+    (ref layer_construction.py:70)."""
+    layer_num: int = 2
+    # cost tolerance: allow up to eps relative imbalance for less comm
+    eps: float = 0.6
+    # layers must contain at least this many non-trivial ops
+    cost_criteria: str = "flops"
+
+
+@dataclasses.dataclass
+class FollowLayerOption(LayerOption):
+    """Reuse another function's clustering (ref layer_construction.py:121)."""
+    layer_num: int = 2
+
+
+# ---- active-option context used by alpa_tpu.grad ----
+_layer_ctx = threading.local()
+
+
+def set_current_layer_option(opt: Optional[LayerOption]):
+    _layer_ctx.opt = opt
+
+
+def current_layer_option() -> Optional[LayerOption]:
+    return getattr(_layer_ctx, "opt", None)
+
+
+########################################
+# clustering
+########################################
+
+
+def _eqn_is_boundary(eqn) -> bool:
+    return (eqn.primitive is pipeline_p and
+            eqn.params["mark_type"] == "boundary")
+
+
+def slice_eqns_by_boundary(closed_jaxpr: ClosedJaxpr) -> List[List]:
+    """Split eqns at boundary markers (ref slice_eqns_by_pipeline_marks)."""
+    groups, cur = [], []
+    for eqn in closed_jaxpr.jaxpr.eqns:
+        if _eqn_is_boundary(eqn):
+            if cur:
+                groups.append(cur)
+            cur = []
+        else:
+            cur.append(eqn)
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def cluster_eqns_by_cost(closed_jaxpr: ClosedJaxpr, layer_num: int,
+                         eps: float = 0.6) -> List[List]:
+    """DP clustering of eqns into ``layer_num`` contiguous groups.
+
+    Re-derivation of ref ``cluster_jaxpr_by_cost`` (layer_construction.py:
+    342-422): minimize cross-layer transferred bytes subject to each layer's
+    flops <= (1 + eps) * (total / layer_num).  DP over (eqn index, layers
+    used) with O(n^2 k) transitions; n is kept manageable by grouping at
+    "heavy op" granularity.
+    """
+    eqns = closed_jaxpr.jaxpr.eqns
+    n = len(eqns)
+    if n == 0 or layer_num <= 1:
+        return [list(eqns)]
+    flops = np.array([jaxpr_eqn_flops(e) for e in eqns])
+    total = flops.sum()
+    budget = (1 + eps) * total / layer_num
+
+    # cumulative flops for O(1) range cost
+    cum = np.concatenate([[0], np.cumsum(flops)])
+
+    # outgoing bytes if we cut after eqn i: vars defined at <= i used at > i
+    defined_at = {}
+    for i, e in enumerate(eqns):
+        for v in e.outvars:
+            defined_at[v] = i
+    last_use = {}
+    for i, e in enumerate(eqns):
+        for v in e.invars:
+            if isinstance(v, Var) and v in defined_at:
+                last_use[v] = i
+    for v in closed_jaxpr.jaxpr.outvars:
+        if isinstance(v, Var) and v in defined_at:
+            last_use[v] = n
+    cut_bytes = np.zeros(n + 1)
+    for v, d in defined_at.items():
+        lu = last_use.get(v, d)
+        if lu > d and hasattr(v.aval, "shape"):
+            b = float(np.prod(v.aval.shape) if v.aval.shape else 1) * \
+                v.aval.dtype.itemsize
+            # v crosses every cut in (d, lu]
+            cut_bytes[d + 1:lu + 1] += b
+
+    INF = float("inf")
+    # f[k][i]: min comm cost of grouping first i eqns into k layers
+    f = np.full((layer_num + 1, n + 1), INF)
+    arg = np.zeros((layer_num + 1, n + 1), dtype=int)
+    f[0][0] = 0.0
+    for k in range(1, layer_num + 1):
+        for i in range(1, n + 1):
+            for j in range(0, i):
+                if cum[i] - cum[j] > budget and k < layer_num:
+                    continue
+                if f[k - 1][j] == INF:
+                    continue
+                c = f[k - 1][j] + (cut_bytes[j] if j > 0 else 0.0)
+                if c < f[k][i]:
+                    f[k][i] = c
+                    arg[k][i] = j
+    if f[layer_num][n] == INF:
+        # fall back to equal-flops split
+        return _equal_flops_split(eqns, flops, layer_num)
+    # backtrack
+    cuts = []
+    i = n
+    for k in range(layer_num, 0, -1):
+        j = arg[k][i]
+        cuts.append((j, i))
+        i = j
+    cuts.reverse()
+    return [list(eqns[a:b]) for a, b in cuts if b > a]
+
+
+def _equal_flops_split(eqns, flops, layer_num):
+    total = flops.sum()
+    target = total / layer_num
+    groups, cur, acc = [], [], 0.0
+    for e, fl in zip(eqns, flops):
+        cur.append(e)
+        acc += fl
+        if acc >= target and len(groups) < layer_num - 1:
+            groups.append(cur)
+            cur, acc = [], 0.0
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+########################################
+# marker insertion
+########################################
+
+
+def add_pipeline_marks_for_sliced_eqns(closed_jaxpr: ClosedJaxpr,
+                                       sliced_eqns: List[List]
+                                       ) -> ClosedJaxpr:
+    """Wrap each eqn group in full start/end pipeline markers
+    (ref layer_construction.py add_pipeline_marks_for_sliced_eqns).
+
+    Every value entering a layer passes through its start marker and every
+    value leaving through its end marker, so jaxpr slicing after autodiff
+    can reconstruct layer boundaries exactly.
+    """
+    from alpa_tpu.util import gensym_var
+
+    jaxpr = closed_jaxpr.jaxpr
+    global_invars = OrderedSet(jaxpr.invars)
+    global_consts = OrderedSet(jaxpr.constvars)
+
+    defined_in_layer = []  # var -> layer idx
+    var_layer = {}
+    for li, group in enumerate(sliced_eqns):
+        for e in group:
+            for v in e.outvars:
+                var_layer[v] = li
+
+    new_eqns = []
+    # per-layer remapping of vars
+    for li, group in enumerate(sliced_eqns):
+        # inputs: vars used in this layer defined outside it
+        layer_invars = OrderedSet()
+        for e in group:
+            for v in e.invars:
+                if isinstance(v, Literal):
+                    continue
+                if var_layer.get(v, -1) != li:
+                    layer_invars.add(v)
+        # outputs: vars defined here used later / globally
+        layer_outvars = OrderedSet()
+        used_later = OrderedSet()
+        for lj in range(li + 1, len(sliced_eqns)):
+            for e in sliced_eqns[lj]:
+                for v in e.invars:
+                    if isinstance(v, Var):
+                        used_later.add(v)
+        for v in jaxpr.outvars:
+            if isinstance(v, Var):
+                used_later.add(v)
+        for e in group:
+            for v in e.outvars:
+                if v in used_later:
+                    layer_outvars.add(v)
+
+        in_list = list(layer_invars)
+        in_map = {v: gensym_var(v.aval) for v in in_list}
+        start_eqn = new_jaxpr_eqn(
+            in_list, [in_map[v] for v in in_list], pipeline_p,
+            dict(name=f"layer_{li}", mark_type="start"))
+        new_eqns.append(start_eqn)
+
+        out_list = list(layer_outvars)
+        out_pre = {v: gensym_var(v.aval) for v in out_list}
+
+        sub = dict(in_map)
+        sub.update(out_pre)
+
+        def substitute(v):
+            if isinstance(v, Literal):
+                return v
+            return sub.get(v, v)
+
+        for e in group:
+            new_eqns.append(
+                e.replace(invars=[substitute(v) for v in e.invars],
+                          outvars=[out_pre.get(v, v) for v in e.outvars]))
+        end_eqn = new_jaxpr_eqn(
+            [out_pre[v] for v in out_list], out_list, pipeline_p,
+            dict(name=f"layer_{li}", mark_type="end"))
+        new_eqns.append(end_eqn)
+
+    return clone_jaxpr(closed_jaxpr, eqns=new_eqns)
+
+
+########################################
+# the loss-function transform
+########################################
+
+
+def layer_level_transform(fn: Callable, layer_option: LayerOption) -> Callable:
+    """Wrap a loss function so tracing it yields a fully layer-marked jaxpr
+    (ref manual/automatic_layer_construction decorators)."""
+
+    def wrapped(*args, **kwargs):
+        closed_jaxpr, out_tree = _make_jaxpr_with_tree(fn, *args, **kwargs)
+        if isinstance(layer_option, AutoLayerOption):
+            sliced = cluster_eqns_by_cost(closed_jaxpr,
+                                          layer_option.layer_num,
+                                          layer_option.eps)
+        else:
+            sliced = slice_eqns_by_boundary(closed_jaxpr)
+        marked = add_pipeline_marks_for_sliced_eqns(closed_jaxpr, sliced)
+        run = jaxpr_as_fun(marked)
+        if layer_option.remat_layer:
+            run = _remat_by_layer(marked)
+        flat_args = jax.tree_util.tree_leaves((args, kwargs))
+        out_flat = run(*flat_args)
+        return jax.tree_util.tree_unflatten(out_tree, out_flat)
+
+    return wrapped
+
+
+def _make_jaxpr_with_tree(fn, *args, **kwargs):
+    flat_args, in_tree = jax.tree_util.tree_flatten((args, kwargs))
+    out_store = [None]
+
+    def flat_fn(*flat):
+        a, kw = jax.tree_util.tree_unflatten(in_tree, list(flat))
+        out = fn(*a, **kw)
+        out_flat, tree = jax.tree_util.tree_flatten(out)
+        out_store[0] = tree
+        return out_flat
+
+    closed_jaxpr = jax.make_jaxpr(flat_fn)(*flat_args)
+    return closed_jaxpr, out_store[0]
+
+
+def _remat_by_layer(marked_jaxpr: ClosedJaxpr) -> Callable:
+    """Apply jax.checkpoint per layer: rebuild the function layer by layer,
+    wrapping each layer's computation in jax.remat and re-emitting the full
+    start/end marker pair around it so downstream slicing still works
+    (ref remat integration, layer_construction.py:542-606)."""
+    from alpa_tpu.pipeline_parallel.computation import (
+        mark_missing_vars_in_backward_computation_pipeline_marks,
+        slice_closed_jaxpr_by_full_pipeline_marks)
+
+    computations, _meta = slice_closed_jaxpr_by_full_pipeline_marks(
+        marked_jaxpr, strict=False)
+    computations = mark_missing_vars_in_backward_computation_pipeline_marks(
+        computations, marked_jaxpr.jaxpr.invars)
+
+    def run(*flat_args):
+        env = {}
+        jaxpr = marked_jaxpr.jaxpr
+        for v, a in zip(jaxpr.invars, flat_args):
+            env[v] = a
+        for cv, c in zip(jaxpr.constvars, marked_jaxpr.consts):
+            env[cv] = c
+
+        for comp in computations:
+            fn = jax.checkpoint(jaxpr_as_fun(comp.closed_jaxpr()))
+            args = [env[v] for v in comp.invars]
+            # full marker protocol: start(inputs) -> remat body -> end(outs)
+            args = pipeline_p.bind(*args, name=comp.name, mark_type="start")
+            outs = fn(*args)
+            outs = pipeline_p.bind(*outs, name=comp.name, mark_type="end")
+            for v, o in zip(comp.outvars, outs):
+                env[v] = o
+
+        def read(v):
+            if isinstance(v, Literal):
+                return v.val
+            return env[v]
+
+        return [read(v) for v in jaxpr.outvars]
+
+    return run

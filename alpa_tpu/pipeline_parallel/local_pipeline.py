@@ -1,0 +1,79 @@
+"""Single-device pipeline interpreter for debugging.
+
+Analog of ref ``alpa/pipeline_parallel/local_pipeline.py:16``
+(``LocalPipelineRunner``): runs the layer computations sequentially on one
+device, exactly following the sliced jaxprs — useful for isolating pipeline
+slicing bugs from runtime bugs.
+"""
+import logging
+from typing import Any, Dict, List, Sequence
+
+import jax
+from jax._src.core import jaxpr_as_fun
+from jax.extend.core import Literal, Var
+
+from alpa_tpu.pipeline_parallel.computation import (
+    JaxPipelineComputation,
+    mark_missing_vars_in_backward_computation_pipeline_marks, pipeline_dce,
+    slice_closed_jaxpr_by_full_pipeline_marks)
+from alpa_tpu.pipeline_parallel.layer_construction import (
+    AutoLayerOption, set_current_layer_option)
+
+logger = logging.getLogger(__name__)
+
+
+class LocalPipelineExecutable:
+    """Interpret sliced computations sequentially on the default device."""
+
+    def __init__(self, fun, in_avals, layer_option=None):
+        set_current_layer_option(layer_option or AutoLayerOption(layer_num=2))
+        try:
+            closed_jaxpr = jax.make_jaxpr(fun)(*in_avals)
+        finally:
+            set_current_layer_option(None)
+        self.closed_jaxpr = closed_jaxpr
+        computations, _ = slice_closed_jaxpr_by_full_pipeline_marks(
+            closed_jaxpr)
+        if computations:
+            computations = \
+                mark_missing_vars_in_backward_computation_pipeline_marks(
+                    computations, closed_jaxpr.jaxpr.invars)
+        self.computations = computations
+        self.in_avals = in_avals
+        self.out_tree = None
+
+    def launch_on_driver(self, *flat_args):
+        jaxpr = self.closed_jaxpr.jaxpr
+        env: Dict[Var, Any] = {}
+        for v, a in zip(jaxpr.invars, flat_args):
+            env[v] = a
+        for cv, c in zip(jaxpr.constvars, self.closed_jaxpr.consts):
+            env[cv] = c
+
+        def read(v):
+            return v.val if isinstance(v, Literal) else env[v]
+
+        if not self.computations:
+            fn = jaxpr_as_fun(self.closed_jaxpr)
+            return fn(*flat_args)
+
+        for comp in self.computations:
+            fn = comp.get_runnable()
+            args = [read(v) for v in comp.invars]
+            outs = fn(*args)
+            for v, o in zip(comp.outvars, outs):
+                env[v] = o
+        # any eqns outside computations (e.g. grad marker, apply) run via
+        # the full jaxpr fallback when outputs are missing
+        missing = [
+            v for v in jaxpr.outvars
+            if isinstance(v, Var) and v not in env
+        ]
+        if missing:
+            fn = jaxpr_as_fun(self.closed_jaxpr)
+            return fn(*flat_args)
+        return [read(v) for v in jaxpr.outvars]
+
+
+def compile_local_pipeline_executable(fun, in_avals, in_tree):
+    return LocalPipelineExecutable(fun, in_avals)

@@ -1,0 +1,120 @@
+"""Pipeline instruction emitter.
+
+Analog of ref ``alpa/pipeline_parallel/runtime_emitter.py`` (SURVEY.md
+§2.4): walk the schedule tick by tick and compile it into a static
+instruction list.  Single-controller simplifications vs the reference:
+
+* ``SEND``/``RECV``/``BROADCAST`` collapse into one ``RESHARD`` instruction
+  executed as ``jax.device_put`` (the jax runtime moves data between meshes
+  over ICI/DCN; ref cross_mesh_resharding's NCCL P2P machinery becomes the
+  runtime's transfer engine).
+* There is one global instruction stream instead of per-host worker
+  streams; jax's async dispatch provides cross-mesh overlap.
+* ``FREE`` is emitted from liveness analysis like the reference
+  (``_compile_free``, ref runtime_emitter.py:1087) and drops env references
+  so buffers are reclaimed promptly.
+
+Value identity: (var, instance) where instance = microbatch index for
+per-microbatch values and -1 for microbatch-invariant ones (params, grad
+accumulators, apply-grad results).
+"""
+import dataclasses
+import enum
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from jax.extend.core import Var
+
+logger = logging.getLogger(__name__)
+
+
+class PipelineInstType(enum.IntEnum):
+    """(ref runtime_emitter.py:31)"""
+    RUN = 0
+    RESHARD = 1
+    FREE = 2
+
+
+@dataclasses.dataclass
+class PipelineInstruction:
+    """(ref runtime_emitter.py:47)"""
+    opcode: PipelineInstType
+    # RUN
+    stage_id: Optional[int] = None
+    micro_batch: Optional[int] = None
+    input_keys: Optional[List[Tuple[int, int]]] = None   # (var_id, inst)
+    output_keys: Optional[List[Tuple[int, int]]] = None
+    # RESHARD
+    var_key: Optional[Tuple[int, int]] = None
+    src_mesh: Optional[int] = None
+    dst_mesh: Optional[int] = None
+    dst_sharding: Any = None
+    # FREE
+    free_keys: Optional[List[Tuple[int, int, int]]] = None  # (var,inst,mesh)
+    info: str = ""
+
+    def __repr__(self):
+        if self.opcode == PipelineInstType.RUN:
+            return (f"RUN(stage={self.stage_id}, mb={self.micro_batch})")
+        if self.opcode == PipelineInstType.RESHARD:
+            return (f"RESHARD({self.var_key}, {self.src_mesh}->"
+                    f"{self.dst_mesh})")
+        return f"FREE({len(self.free_keys)})"
+
+
+@dataclasses.dataclass
+class PlacementSpecEntry:
+    """Where a global input lives: list of (mesh_id, sharding)."""
+    mesh_ids: List[int]
+    shardings: List[Any]
+    is_batch: bool = False
+
+
+@dataclasses.dataclass
+class PipeshardConfig:
+    """The full compiled artifact (ref runtime_emitter.py:228)."""
+    instructions: List[PipelineInstruction]
+    # global invar index -> placement
+    input_placements: List[PlacementSpecEntry]
+    # accumulator allocations: (var_id, mesh_id, aval, sharding)
+    acc_allocs: List[Tuple[int, int, Any, Any]]
+    # flat output -> (var_id, inst, mesh_id)
+    output_specs: List[Tuple[int, int, int]]
+    num_micro_batches: int
+    num_meshes: int
+    var_ids: Dict[Var, int]
+    # (var_id, inst) -> producing mesh (for debugging)
+    schedule_text: str = ""
+
+
+def emit_free_instructions(instructions: List[PipelineInstruction],
+                           protected_keys) -> List[PipelineInstruction]:
+    """Insert FREE after the last use of each (var, inst, mesh) value
+    (ref _compile_free, runtime_emitter.py:1087)."""
+    last_use: Dict[Tuple[int, int, int], int] = {}
+    defined: Dict[Tuple[int, int, int], int] = {}
+    for i, inst in enumerate(instructions):
+        if inst.opcode == PipelineInstType.RUN:
+            mesh = inst.dst_mesh
+            for k in inst.input_keys:
+                last_use[(k[0], k[1], mesh)] = i
+            for k in inst.output_keys:
+                defined[(k[0], k[1], mesh)] = i
+        elif inst.opcode == PipelineInstType.RESHARD:
+            last_use[(inst.var_key[0], inst.var_key[1], inst.src_mesh)] = i
+            defined[(inst.var_key[0], inst.var_key[1], inst.dst_mesh)] = i
+    out: List[PipelineInstruction] = []
+    frees_at: Dict[int, List[Tuple[int, int, int]]] = {}
+    for key, i in last_use.items():
+        if key in protected_keys:
+            continue
+        if key not in defined:
+            continue  # inputs placed at launch are managed by the driver
+        frees_at.setdefault(i, []).append(key)
+    for i, inst in enumerate(instructions):
+        out.append(inst)
+        if i in frees_at:
+            out.append(
+                PipelineInstruction(PipelineInstType.FREE,
+                                    free_keys=frees_at[i]))
+    return out

@@ -1,0 +1,178 @@
+"""Stage construction: cluster layers into stages and assign submeshes.
+
+Analog of ref ``alpa/pipeline_parallel/stage_construction.py`` (SURVEY.md
+§2.4).  This module provides the option surface
+(``UniformStageOption``/``ManualStageOption``/``AutoStageOption``), submesh
+enumeration, and mesh slicing; the OSDI'22 auto DP algorithm lives in
+``stage_dp.py`` (with a C++ native implementation) and is driven from here
+when ``AutoStageOption`` is used.
+"""
+import dataclasses
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from alpa_tpu.device_mesh import VirtualPhysicalMesh
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StageOption:
+    """Base (ref stage_construction.py)."""
+
+
+@dataclasses.dataclass
+class UniformStageOption(StageOption):
+    """Evenly assign layers to stages = meshes (ref :70)."""
+    num_stages: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ManualStageOption(StageOption):
+    """Explicit layer->stage and stage->submesh assignment (ref :57)."""
+    forward_stage_layer_ids: List[List[int]] = None
+    submesh_physical_shapes: List[Sequence[int]] = None
+    submesh_logical_shapes: List[Sequence[int]] = None
+    submesh_autosharding_option_dicts: List[Dict] = None
+
+
+@dataclasses.dataclass
+class AutoStageOption(StageOption):
+    """Search layer->stage clustering + submesh shapes with the OSDI'22 DP
+    (ref :28)."""
+    submesh_physical_shape_space: str = "power_of_two"
+    submesh_logical_shape_space: str = "single_node_model_parallel"
+    stage_imbalance_tolerance: float = np.inf
+    use_hlo_cost_model: bool = True
+    profiling_database_filename: Optional[str] = None
+
+
+def get_submesh_choices(num_hosts: int, num_devices_per_host: int,
+                        space: str = "power_of_two"
+                        ) -> List[Tuple[int, int]]:
+    """Enumerate candidate submesh shapes (ref get_submesh_choices:414):
+    (1, 2^k) within a host plus (k, full host) across hosts."""
+    choices = []
+    i = 1
+    while i <= num_devices_per_host:
+        choices.append((1, i))
+        i *= 2
+    assert choices[-1][1] == num_devices_per_host, (
+        "num_devices_per_host must be a power of two")
+    for k in range(2, num_hosts + 1):
+        if space == "all" or num_hosts % k == 0 or space == "power_of_two":
+            choices.append((k, num_devices_per_host))
+    return choices
+
+
+def get_sliced_virtual_submeshes(virtual_mesh: VirtualPhysicalMesh,
+                                 submesh_shapes: List[Sequence[int]]
+                                 ) -> List[VirtualPhysicalMesh]:
+    """Carve the cluster into the requested submeshes
+    (ref get_sliced_virtual_submeshes:529).
+
+    Host-spanning submeshes take whole hosts; sub-host submeshes pack into
+    hosts left to right.
+    """
+    num_hosts = virtual_mesh.num_hosts
+    ndph = virtual_mesh.num_devices_per_host
+    total_requested = sum(int(np.prod(s)) for s in submesh_shapes)
+    assert total_requested <= virtual_mesh.num_devices, (
+        f"requested {total_requested} devices > {virtual_mesh.num_devices}")
+    submeshes = []
+    host_ptr = 0
+    dev_ptr = 0
+    for shape in submesh_shapes:
+        h, d = int(shape[0]), int(shape[1])
+        if h > 1 or d == ndph:
+            # whole-host slices
+            if dev_ptr != 0:
+                host_ptr += 1
+                dev_ptr = 0
+            assert host_ptr + h <= num_hosts, "not enough hosts"
+            sub = virtual_mesh.slice_2d(range(host_ptr, host_ptr + h),
+                                        range(d))
+            host_ptr += h
+        else:
+            if dev_ptr + d > ndph:
+                host_ptr += 1
+                dev_ptr = 0
+            assert host_ptr < num_hosts, "not enough devices"
+            sub = virtual_mesh.slice_2d([host_ptr],
+                                        range(dev_ptr, dev_ptr + d))
+            dev_ptr += d
+        submeshes.append(sub)
+    return submeshes
+
+
+def uniform_layer_to_stage(num_layers: int, num_stages: int
+                           ) -> List[List[int]]:
+    """Evenly group forward layers into stages."""
+    base, rem = divmod(num_layers, num_stages)
+    out, start = [], 0
+    for i in range(num_stages):
+        size = base + (1 if i < rem else 0)
+        out.append(list(range(start, start + size)))
+        start += size
+    return out
+
+
+def cluster_layers_and_slice_mesh(
+        num_forward_layers: int,
+        virtual_mesh: VirtualPhysicalMesh,
+        stage_option: Optional[StageOption],
+        layer_flops: Optional[Sequence[float]] = None,
+        layer_comps=None,
+        donation_mapping=None,
+        num_micro_batches: int = 1,
+        auto_sharding_option=None):
+    """Decide (forward_stage_layer_ids, submeshes, logical shapes, per-stage
+    autosharding dicts) (ref cluster_layers_and_slice_mesh:571)."""
+    stage_option = stage_option or UniformStageOption()
+
+    if isinstance(stage_option, ManualStageOption):
+        fwd_ids = stage_option.forward_stage_layer_ids
+        phys_shapes = stage_option.submesh_physical_shapes
+        logical_shapes = (stage_option.submesh_logical_shapes or
+                          [None] * len(fwd_ids))
+        as_dicts = (stage_option.submesh_autosharding_option_dicts or
+                    [{}] * len(fwd_ids))
+        submeshes = get_sliced_virtual_submeshes(virtual_mesh, phys_shapes)
+        return fwd_ids, submeshes, logical_shapes, as_dicts
+
+    if isinstance(stage_option, AutoStageOption):
+        from alpa_tpu.pipeline_parallel.stage_dp import auto_stage_dp
+        return auto_stage_dp(num_forward_layers, virtual_mesh, stage_option,
+                             layer_flops, layer_comps, num_micro_batches,
+                             auto_sharding_option)
+
+    # Uniform: num_stages = num_hosts (or all devices as equal slices)
+    num_stages = (stage_option.num_stages if isinstance(
+        stage_option, UniformStageOption) and stage_option.num_stages else
+        None)
+    if num_stages is None:
+        num_stages = (virtual_mesh.num_hosts if virtual_mesh.num_hosts > 1
+                      else min(num_forward_layers,
+                               virtual_mesh.num_devices_per_host))
+    num_stages = min(num_stages, num_forward_layers)
+    fwd_ids = uniform_layer_to_stage(num_forward_layers, num_stages)
+    # split devices evenly
+    if virtual_mesh.num_hosts >= num_stages and \
+            virtual_mesh.num_hosts % num_stages == 0:
+        hosts_per = virtual_mesh.num_hosts // num_stages
+        phys_shapes = [(hosts_per, virtual_mesh.num_devices_per_host)
+                       for _ in range(num_stages)]
+    else:
+        devs_per = virtual_mesh.num_devices // num_stages
+        assert devs_per >= 1 and \
+            virtual_mesh.num_devices % num_stages == 0, (
+                f"cannot split {virtual_mesh.num_devices} devices into "
+                f"{num_stages} equal pipeline stages; pass a stage_option "
+                f"with num_stages dividing the device count")
+        phys_shapes = [(1, devs_per) for _ in range(num_stages)]
+    submeshes = get_sliced_virtual_submeshes(virtual_mesh, phys_shapes)
+    logical_shapes = [None] * num_stages
+    as_dicts = [{}] * num_stages
+    return fwd_ids, submeshes, logical_shapes, as_dicts

@@ -1,0 +1,14 @@
+"""Auto stage construction via the OSDI'22 dynamic program.
+
+Analog of ref ``training_dp_impl`` (``stage_construction.py:235``) +
+``get_compute_cost`` (``stage_profiling.py:1163``).  The DP and the
+cost-model-based stage profiling land with the auto-stage milestone; a
+clear error guards the entry until then.
+"""
+
+
+def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
+                  layer_comps, num_micro_batches, auto_sharding_option):
+    raise NotImplementedError(
+        "AutoStageOption (profile-and-DP stage construction) is not wired "
+        "yet; use UniformStageOption or ManualStageOption.")

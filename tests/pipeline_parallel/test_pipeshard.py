@@ -1,0 +1,196 @@
+"""Pipeshard end-to-end correctness (ref PipelineBasicTest, testing.py:233).
+
+Oracle: PipeshardParallel == serial numerics across schedules, microbatch
+counts, manual/auto layers, and models.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import alpa_tpu
+from alpa_tpu import PipeshardParallel, get_3d_parallel_method
+from alpa_tpu.pipeline_parallel.layer_construction import (AutoLayerOption,
+                                                           ManualLayerOption)
+from alpa_tpu.pipeline_parallel.stage_construction import (ManualStageOption,
+                                                           UniformStageOption)
+from alpa_tpu.testing import (assert_allclose, create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+def _compare_pipeshard(method, n_steps=2, rtol=2e-3, num_layers=4,
+                       manual=True):
+    alpa_tpu.init(cluster="local")
+    state_p, batch = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=num_layers, manual_pipeline_layer=manual)
+    state_s, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=num_layers, manual_pipeline_layer=manual)
+    pstep = get_mlp_train_step(method, use_value_and_grad=True)
+    serial = get_mlp_train_step(None)
+    for _ in range(n_steps):
+        state_p, loss_p = pstep(state_p, batch)
+        state_s, loss_s = serial(state_s, batch)
+    assert_allclose(float(loss_s), float(loss_p), rtol, rtol)
+    assert_allclose(jax.device_get(state_s.params),
+                    jax.device_get(state_p.params), rtol, rtol)
+    return pstep.get_last_executable()
+
+
+class TestPipeshard:
+
+    def test_1f1b_manual_layers(self):
+        ex = _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=2,
+                              layer_option=ManualLayerOption(),
+                              stage_option=UniformStageOption(num_stages=2),
+                              pipeline_schedule="1f1b"))
+        assert ex.num_meshes == 2
+
+    def test_gpipe(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=4,
+                              layer_option=ManualLayerOption(),
+                              stage_option=UniformStageOption(num_stages=2),
+                              pipeline_schedule="gpipe"))
+
+    def test_auto_layers(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=2,
+                              layer_option=AutoLayerOption(layer_num=2),
+                              stage_option=UniformStageOption(num_stages=2)),
+            manual=False)
+
+    def test_single_microbatch(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=1,
+                              layer_option=ManualLayerOption(),
+                              stage_option=UniformStageOption(num_stages=2)))
+
+    def test_four_stages(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=2,
+                              layer_option=AutoLayerOption(layer_num=4),
+                              stage_option=UniformStageOption(num_stages=4)),
+            num_layers=8, manual=False)
+
+    def test_3d_parallel_method(self):
+        alpa_tpu.init(cluster="local")
+        method = get_3d_parallel_method(num_micro_batches=2,
+                                        data_parallel=2,
+                                        operator_parallel=2,
+                                        pipeline_parallel=2)
+        _compare_pipeshard(method)
+
+    def test_remat_layers(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=2,
+                              layer_option=ManualLayerOption(
+                                  remat_layer=True),
+                              stage_option=UniformStageOption(num_stages=2)))
+
+    def test_global_norm_clipping_falls_back_single_mesh_apply(self):
+        """clip_by_global_norm creates a cyclic apply partition; the driver
+        must fall back to single-mesh apply and stay correct."""
+        import optax
+        from flax.training import train_state
+
+        from alpa_tpu.testing import MLPModel
+
+        alpa_tpu.init(cluster="local")
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (64, 32))
+        y = jax.random.normal(rng, (64, 32))
+        model = MLPModel(hidden_dim=32, output_dim=32, num_layers=4,
+                         manual_pipeline_layer=True)
+        tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adam(1e-3))
+
+        def mkstate():
+            return train_state.TrainState.create(apply_fn=model.apply,
+                                                 params=model.init(rng, x),
+                                                 tx=tx)
+
+        def step(state, batch):
+
+            def loss_fn(p):
+                out = state.apply_fn(p, batch["x"])
+                return jnp.mean((out - batch["y"])**2)
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        batch = {"x": x, "y": y}
+        method = PipeshardParallel(num_micro_batches=2,
+                                   layer_option=ManualLayerOption(),
+                                   stage_option=UniformStageOption(
+                                       num_stages=2))
+        pstep = alpa_tpu.parallelize(step, method=method)
+        serial = jax.jit(step)
+        sp, ssr = mkstate(), mkstate()
+        for _ in range(2):
+            sp, lp = pstep(sp, batch)
+            ssr, ls = serial(ssr, batch)
+        assert_allclose(float(ls), float(lp), 1e-3, 1e-3)
+        assert_allclose(jax.device_get(ssr.params),
+                        jax.device_get(sp.params), 2e-3, 2e-3)
+
+    def test_executable_introspection(self):
+        ex = _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=2,
+                              layer_option=ManualLayerOption(),
+                              stage_option=UniformStageOption(num_stages=2)))
+        assert "HloModule" in ex.get_hlo_text()
+        assert "b0s0" in ex.get_schedule_text()
+        assert "RUN" in ex.get_instruction_text()
+
+
+class TestPipeshardGPT:
+
+    def test_gpt_pipeline(self):
+        import optax
+        from flax.training import train_state
+
+        from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
+        from alpa_tpu.model.model_util import cross_entropy_loss
+
+        alpa_tpu.init(cluster="local")
+        config = GPTConfig(hidden_size=32, num_layers=4, num_heads=4,
+                           seq_len=32, vocab_size=64,
+                           pipeline_boundary_every=2)
+        model = GPTModel(config)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (8, 32), 0, 64)
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 64)
+
+        def make_state():
+            params = model.init(rng, ids)
+            return train_state.TrainState.create(
+                apply_fn=model.apply, params=params, tx=optax.adam(1e-3))
+
+        def train_step_fn(state, batch):
+
+            def loss_fn(p):
+                logits = state.apply_fn(p, batch["ids"])
+                return cross_entropy_loss(logits.astype(jnp.float32),
+                                          batch["labels"])
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        batch = {"ids": ids, "labels": labels}
+        method = PipeshardParallel(num_micro_batches=2,
+                                   layer_option=ManualLayerOption(),
+                                   stage_option=UniformStageOption(
+                                       num_stages=2))
+        pstep = alpa_tpu.parallelize(train_step_fn, method=method)
+        serial = jax.jit(train_step_fn)
+        sp, ss = make_state(), make_state()
+        for _ in range(2):
+            sp, lp = pstep(sp, batch)
+            ss, ls = serial(ss, batch)
+        assert_allclose(float(ls), float(lp), 2e-3, 2e-3)
+        assert_allclose(jax.device_get(ss.params),
+                        jax.device_get(sp.params), 5e-3, 5e-3)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

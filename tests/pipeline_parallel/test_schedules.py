@@ -1,0 +1,83 @@
+"""Schedule unit tests (ref tests/pipeline_parallel/test_schedules.py)."""
+import numpy as np
+import pytest
+
+from alpa_tpu.pipeline_parallel.schedules import (GpipeSchedule,
+                                                  InferenceSchedule,
+                                                  PipeDreamFlush,
+                                                  create_pipeline_schedule)
+
+
+def _check_complete(sched, num_meshes, num_batch, has_backward=True):
+    """Every (mb, stage) task appears exactly once, dependencies hold."""
+    seen = {}
+    fwd_clock = {}
+    bwd_clock = {}
+    for k, tick in enumerate(sched.schedules):
+        assert len(tick) == num_meshes
+        for d, task in enumerate(tick):
+            if task is None:
+                continue
+            assert task not in seen, f"duplicate task {task}"
+            seen[task] = k
+            mb, s = task
+            if s < num_meshes:
+                fwd_clock[(mb, s)] = k
+            else:
+                bwd_clock[(mb, 2 * num_meshes - 1 - s)] = k
+    expected = num_meshes * num_batch * (2 if has_backward else 1)
+    assert len(seen) == expected, f"{len(seen)} != {expected}"
+    # forward deps: F(mb, s) after F(mb, s-1)
+    for (mb, s), k in fwd_clock.items():
+        if s > 0:
+            assert fwd_clock[(mb, s - 1)] < k
+    for (mb, d), k in bwd_clock.items():
+        assert fwd_clock[(mb, d)] < k
+        if d < num_meshes - 1:
+            assert bwd_clock[(mb, d + 1)] < k
+
+
+class TestSchedules:
+
+    @pytest.mark.parametrize("m,n", [(2, 2), (2, 4), (4, 8), (3, 5)])
+    def test_gpipe_complete(self, m, n):
+        s = GpipeSchedule(num_stages=2 * m, num_meshes=m, num_batch=n)
+        _check_complete(s, m, n)
+
+    @pytest.mark.parametrize("m,n", [(2, 2), (2, 4), (4, 8), (3, 5), (4, 2)])
+    def test_1f1b_complete(self, m, n):
+        s = PipeDreamFlush(num_stages=2 * m, num_meshes=m, num_batch=n)
+        _check_complete(s, m, n)
+
+    def test_1f1b_memory_bound(self):
+        """1F1B: mesh 0 holds at most m in-flight forward microbatches."""
+        m, n = 4, 16
+        s = PipeDreamFlush(num_stages=2 * m, num_meshes=m, num_batch=n)
+        in_flight = 0
+        max_in_flight = 0
+        for tick in s.schedules:
+            t = tick[0]
+            if t is not None:
+                if t[1] == 0:
+                    in_flight += 1
+                else:
+                    in_flight -= 1
+                max_in_flight = max(max_in_flight, in_flight)
+        assert max_in_flight <= m, max_in_flight
+
+    def test_inference(self):
+        s = InferenceSchedule(num_stages=3, num_meshes=3, num_batch=4)
+        _check_complete(s, 3, 4, has_backward=False)
+
+    def test_factory(self):
+        for name in ("gpipe", "1f1b", "inference"):
+            s = create_pipeline_schedule(name, num_stages=4, num_meshes=2,
+                                         num_batch=2)
+            assert s.num_clock > 0
+        with pytest.raises(ValueError):
+            create_pipeline_schedule("bogus", num_stages=4, num_meshes=2,
+                                     num_batch=2)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
