@@ -1,0 +1,98 @@
+"""BERT-style bidirectional encoder with MLM / classification heads.
+
+Analog of ref ``alpa/model/bert_model.py`` (884 LoC flax BERT).  Reuses the
+shared transformer blocks (gpt_model) with ``causal=False`` — the reference
+inverts this relationship (its GPT wraps BERT with a causal mask,
+ref gpt_model.py:151); either way one block implementation serves both.
+"""
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from alpa_tpu.model.gpt_model import GPTConfig, TransformerBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    seq_len: int = 512
+    type_vocab_size: int = 2
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+
+    def gpt(self) -> GPTConfig:
+        return GPTConfig(vocab_size=self.vocab_size,
+                         hidden_size=self.hidden_size,
+                         num_layers=self.num_layers,
+                         num_heads=self.num_heads,
+                         seq_len=self.seq_len,
+                         mlp_ratio=self.mlp_ratio,
+                         dtype=self.dtype,
+                         causal=False)
+
+
+class BertModel(nn.Module):
+    """Encoder trunk: token + position + segment embeddings, N blocks."""
+    config: BertConfig
+    add_pooling_layer: bool = True
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.config
+        gcfg = cfg.gpt()
+        b, s = input_ids.shape
+        pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                     name="word_embeddings")(input_ids)
+        x = x + nn.Embed(cfg.seq_len, cfg.hidden_size, dtype=cfg.dtype,
+                         name="position_embeddings")(pos)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         name="token_type_embeddings")(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="embeddings_ln")(x)
+        for i in range(cfg.num_layers):
+            x, _ = TransformerBlock(gcfg, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="final_ln")(x)
+        pooled = None
+        if self.add_pooling_layer:
+            pooled = nn.tanh(
+                nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                         name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForMaskedLM(nn.Module):
+    """MLM head over the trunk (ref FlaxBertForMaskedLMModule)."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        cfg = self.config
+        x, _ = BertModel(cfg, add_pooling_layer=False,
+                         name="bert")(input_ids, token_type_ids)
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="transform")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.LayerNorm(dtype=jnp.float32, name="transform_ln")(x)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype,
+                          name="decoder")(x)
+        return logits
+
+
+class BertForSequenceClassification(nn.Module):
+    config: BertConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None):
+        _, pooled = BertModel(self.config, name="bert")(input_ids,
+                                                        token_type_ids)
+        return nn.Dense(self.num_labels, dtype=self.config.dtype,
+                        name="classifier")(pooled)

@@ -47,6 +47,8 @@ class GPTConfig:
     # mesh axis name for ring attention (sequence parallel)
     sp_axis: Optional[str] = None
     tie_embeddings: bool = True
+    # decoder (causal) vs encoder (bidirectional, BERT-style)
+    causal: bool = True
 
 
 # The reference benchmark ladder: name -> (hidden, layers, heads)
@@ -136,7 +138,7 @@ class SelfAttention(nn.Module):
             out = attn
         else:
             attn_fn = get_attention_fn(cfg)
-            out = attn_fn(q, k, v, causal=True)
+            out = attn_fn(q, k, v, causal=cfg.causal)
         out = out.reshape(b, s, h)
         out = nn.Dense(h, dtype=cfg.dtype, name="out")(out)
         return out, new_cache

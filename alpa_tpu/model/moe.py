@@ -1,0 +1,198 @@
+"""Mixture-of-Experts transformer (GShard-style top-2 gating).
+
+Analog of ref ``alpa/model/moe.py`` (einsum-formulated top-2 gating,
+ref :151-184): the expert dimension is expressed as a leading einsum dim so
+sharding it over a mesh axis makes GSPMD insert the dispatch/combine
+all-to-alls (the reference reaches the same end through its ILP
+``allow_all_to_all`` strategies, SURVEY.md §2.7 EP row).
+
+Expert parallelism here is spelled with an explicit
+``with_sharding_constraint`` on the expert dim (``ep_axis``) so the
+all-to-all placement is deterministic rather than propagation-dependent.
+"""
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from alpa_tpu.model.gpt_model import GPTConfig, SelfAttention
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    vocab_size: int = 51200
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    seq_len: int = 1024
+    num_experts: int = 8
+    expert_group_size: int = 512   # tokens per routing group
+    capacity_factor: float = 2.0
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    # every k-th layer uses an MoE MLP (ref benchmark suite uses 2)
+    moe_every: int = 2
+    # mesh axis to shard the expert dim over (None = let GSPMD decide)
+    ep_axis: Optional[str] = None
+
+    def gpt(self) -> GPTConfig:
+        return GPTConfig(vocab_size=self.vocab_size,
+                         hidden_size=self.hidden_size,
+                         num_layers=self.num_layers,
+                         num_heads=self.num_heads,
+                         seq_len=self.seq_len,
+                         mlp_ratio=self.mlp_ratio,
+                         dtype=self.dtype)
+
+
+def top2_gating(logits: jnp.ndarray, capacity: int):
+    """GShard top-2 gating over (G, S, E) router logits.
+
+    Returns (combine_weights (G,S,E,C), dispatch_mask (G,S,E,C), aux_loss).
+    Einsum-formulated so everything is one-hot matmuls (MXU-friendly, no
+    scatters) — the same formulation family as ref moe.py:151-184.
+    """
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    gate1 = jnp.argmax(probs, axis=-1)                       # (G,S)
+    mask1 = jax.nn.one_hot(gate1, e, dtype=jnp.float32)
+    probs_wo1 = probs * (1 - mask1)
+    gate2 = jnp.argmax(probs_wo1, axis=-1)
+    mask2 = jax.nn.one_hot(gate2, e, dtype=jnp.float32)
+
+    # aux load-balancing loss (mean gate prob * mean assignment per expert)
+    density = mask1.mean(axis=1)                             # (G,E)
+    density_proxy = probs.mean(axis=1)
+    aux_loss = (density * density_proxy).sum(-1).mean() * e * e
+
+    # positions within expert capacity
+    pos1 = (jnp.cumsum(mask1, axis=1) - 1) * mask1           # (G,S,E)
+    mask1 = mask1 * (pos1 < capacity)
+    pos1 = pos1 * mask1
+    count1 = mask1.sum(axis=1, keepdims=True)                # (G,1,E)
+    pos2 = (jnp.cumsum(mask2, axis=1) - 1) * mask2 + count1 * mask2
+    mask2 = mask2 * (pos2 < capacity)
+    pos2 = pos2 * mask2
+
+    w1 = (probs * mask1).sum(-1)                             # (G,S)
+    w2 = (probs * mask2).sum(-1)
+    denom = jnp.maximum(w1 + w2, 1e-9)
+    w1, w2 = w1 / denom, w2 / denom
+
+    cap_range = jax.nn.one_hot(pos1.sum(-1).astype(jnp.int32), capacity)
+    disp1 = mask1[..., None] * cap_range[:, :, None, :]      # (G,S,E,C)
+    cap_range2 = jax.nn.one_hot(pos2.sum(-1).astype(jnp.int32), capacity)
+    disp2 = mask2[..., None] * cap_range2[:, :, None, :]
+    combine = w1[:, :, None, None] * disp1 + w2[:, :, None, None] * disp2
+    dispatch = (combine > 0).astype(jnp.float32)
+    return combine, dispatch, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel MLP block."""
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, h = x.shape
+        e = cfg.num_experts
+        gs = min(cfg.expert_group_size, b * s)
+        tokens = x.reshape(-1, h)
+        n_tok = tokens.shape[0]
+        g = max(1, n_tok // gs)
+        tokens = tokens.reshape(g, -1, h)                    # (G, S', H)
+        sp = tokens.shape[1]
+        capacity = max(1, int(cfg.capacity_factor * sp / e))
+
+        router = nn.Dense(e, dtype=jnp.float32, use_bias=False,
+                          name="router")(tokens)
+        combine, dispatch, aux_loss = top2_gating(router, capacity)
+        self.sow("intermediates", "aux_loss", aux_loss)
+
+        # dispatch: (G,S,E,C) x (G,S,H) -> (E, G, C, H)
+        expert_in = jnp.einsum("gsec,gsh->egch", dispatch.astype(x.dtype),
+                               tokens)
+        if cfg.ep_axis is not None:
+            expert_in = jax.lax.with_sharding_constraint(
+                expert_in, PartitionSpec(cfg.ep_axis))
+        # per-expert MLP via leading-dim einsums
+        wi = self.param("wi", nn.initializers.lecun_normal(),
+                        (e, h, cfg.mlp_ratio * h), cfg.dtype)
+        wo = self.param("wo", nn.initializers.lecun_normal(),
+                        (e, cfg.mlp_ratio * h, h), cfg.dtype)
+        hmid = jnp.einsum("egch,ehm->egcm", expert_in, wi)
+        hmid = nn.gelu(hmid, approximate=True)
+        expert_out = jnp.einsum("egcm,emh->egch", hmid, wo)
+        if cfg.ep_axis is not None:
+            expert_out = jax.lax.with_sharding_constraint(
+                expert_out, PartitionSpec(cfg.ep_axis))
+        # combine: (E,G,C,H) x (G,S,E,C) -> (G,S,H)
+        out = jnp.einsum("egch,gsec->gsh", expert_out,
+                         combine.astype(x.dtype))
+        return out.reshape(b, s, h), aux_loss
+
+
+class MoEBlock(nn.Module):
+    config: MoEConfig
+    use_moe: bool
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gcfg = cfg.gpt()
+        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        attn_out, _ = SelfAttention(gcfg, name="attn")(ln1)
+        x = x + attn_out.astype(x.dtype)
+        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        if self.use_moe:
+            mlp_out, aux = MoEMLP(cfg, name="moe")(ln2)
+        else:
+            h = cfg.hidden_size
+            y = nn.Dense(cfg.mlp_ratio * h, dtype=cfg.dtype,
+                         name="fc_in")(ln2)
+            y = nn.gelu(y, approximate=True)
+            mlp_out = nn.Dense(h, dtype=cfg.dtype, name="fc_out")(y)
+            aux = jnp.float32(0.0)
+        return x + mlp_out.astype(x.dtype), aux
+
+
+class MoELMModel(nn.Module):
+    """Decoder LM with alternating dense / MoE blocks
+    (ref benchmark/alpa/suite_auto_moe.py model family)."""
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.config
+        b, s = input_ids.shape
+        pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       name="wte")
+        x = emb(input_ids) + nn.Embed(cfg.seq_len, cfg.hidden_size,
+                                      dtype=cfg.dtype, name="wpe")(pos)
+        aux_total = jnp.float32(0.0)
+        for i in range(cfg.num_layers):
+            use_moe = (cfg.moe_every > 0 and
+                       (i + 1) % cfg.moe_every == 0)
+            x, aux = MoEBlock(cfg, use_moe, name=f"h{i}")(x)
+            aux_total = aux_total + aux
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = emb.attend(x.astype(cfg.dtype))
+        return logits, aux_total
+
+
+# Benchmark ladder (ref benchmark/alpa/suite_auto_moe.py)
+moe_specs = {
+    "380M": (768, 8, 16, 8),
+    "690M": (768, 8, 16, 16),
+    "1.3B": (768, 16, 16, 16),
+    "2.4B": (1024, 16, 16, 16),
+    "10B": (1536, 16, 16, 32),
+    "27B": (2048, 16, 16, 48),
+}

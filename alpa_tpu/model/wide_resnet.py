@@ -1,0 +1,78 @@
+"""Wide-ResNet for the vision benchmark suite.
+
+Analog of ref ``alpa/model/wide_resnet.py`` (176 LoC): the W-ResNet family
+benchmarked in ref ``benchmark/alpa/suite_wresnet.py``.  Convolutions are
+the 2D-sharding workload exercising the planner's conv strategies (spatial
+vs channel vs batch sharding).
+"""
+import dataclasses
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class WResNetConfig:
+    num_layers: int = 50
+    width_factor: int = 2
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+
+_BLOCKS = {
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+    152: (3, 8, 36, 3),
+}
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.GroupNorm, num_groups=32, dtype=jnp.float32)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = norm(name="norm1")(y)
+        y = nn.relu(y)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 name="conv2")(y)
+        y = norm(name="norm2")(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        y = norm(name="norm3")(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            (self.strides, self.strides),
+                            name="proj")(residual)
+            residual = norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class WideResNet(nn.Module):
+    config: WResNetConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        blocks = _BLOCKS[cfg.num_layers]
+        w = cfg.width_factor
+        x = nn.Conv(64 * w, (7, 7), (2, 2), use_bias=False,
+                    dtype=cfg.dtype, name="conv_init")(x)
+        x = nn.GroupNorm(num_groups=32, dtype=jnp.float32,
+                         name="norm_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, n in enumerate(blocks):
+            for j in range(n):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BottleneckBlock(64 * w * (2**i), strides, cfg.dtype,
+                                    name=f"block_{i}_{j}")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(cfg.num_classes, dtype=cfg.dtype, name="head")(x)

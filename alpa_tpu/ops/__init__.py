@@ -1,0 +1,6 @@
+"""TPU kernels (pallas) and kernel-backed ops.
+
+New capability vs the reference (SURVEY.md §2.7: sequence parallelism is
+ABSENT in Alpa): flash attention and ring attention make long-context
+training a first-class citizen of this framework.
+"""

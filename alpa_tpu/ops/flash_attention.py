@@ -1,0 +1,138 @@
+"""Flash attention forward kernel in pallas (TPU), with recompute backward.
+
+Blocked online-softmax attention: the q-block stays in VMEM, k/v stream
+block by block, and the softmax normalizer is maintained incrementally —
+the S x S score matrix never materializes in HBM.  Grid: (batch*heads,
+q blocks); k/v for one (batch, head) are VMEM-resident (fine for the
+moderate per-chip sequence lengths this kernel targets; longer sequences
+are handled by sharding the sequence with ring attention, which calls this
+kernel per block).
+
+Backward: ``jax.custom_vjp`` recomputes attention with the einsum reference
+implementation and differentiates that — the standard remat-style tradeoff
+(saves the O(S^2) residuals; XLA fuses the recomputed backward well).
+"""
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e9
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                      causal: bool, sm_scale: float, q_offset: int):
+    """One (batch*head, q-block) program instance.
+
+    q_ref: (block_q, d); k_ref/v_ref: (s_k, d); o_ref: (block_q, d).
+    """
+    block_q, d = q_ref.shape
+    s_k = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    q_blk = pl.program_id(1)
+    q_start = q_blk * block_q + q_offset
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = pl.cdiv(s_k, block_k)
+
+    def body(kb, carry):
+        m_prev, l_prev, acc = carry
+        k_start = kb * block_k
+        k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(jnp.int32,
+                                                   (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    if causal:
+        # skip fully-masked k blocks beyond the diagonal
+        last_needed = lax.div(q_start + block_q - 1, block_k) + 1
+        n_iter = jnp.minimum(last_needed, num_k_blocks)
+    else:
+        n_iter = num_k_blocks
+    m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-20)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
+                   block_q: int = 256, block_k: int = 256,
+                   interpret: bool = None):
+    """q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (B, Sq, H, D)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sm_scale = 1.0 / np.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+
+    # (B, Sq, H, D) -> (B*H, Sq, D)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, pl.cdiv(sq, block_q))
+    out = pl.pallas_call(
+        partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
+                sm_scale=sm_scale, q_offset=q_offset),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal, q_offset):
+    return _flash_forward(q, k, v, causal=causal, q_offset=q_offset)
+
+
+def _flash_fwd_rule(q, k, v, causal, q_offset):
+    out = _flash_forward(q, k, v, causal=causal, q_offset=q_offset)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, q_offset, res, do):
+    from alpa_tpu.model.gpt_model import reference_attention
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
+                                               offset=q_offset), q, k, v)
+    return vjp(do)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, offset: int = 0):
+    """Drop-in replacement for ``reference_attention`` (gpt_model.py)."""
+    return _flash_attention(q, k, v, causal, offset)

@@ -1,0 +1,141 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+NEW capability vs the reference (SURVEY.md §2.7/§5: Alpa has no sequence /
+context parallelism).  The sequence dim of q/k/v is sharded over a mesh
+axis; each device keeps its q shard and the k/v shards rotate around the
+ring with ``lax.ppermute`` (compiled to ICI neighbor exchanges that XLA
+overlaps with the per-block attention compute).  Softmax statistics are
+combined online across ring steps, so the result is exact attention over
+the full sequence with per-device memory O(S/ring) — long-context training
+scales with the ring size.
+
+Causality with sequence sharding: chunk j of k/v attends to q chunk i as
+  j <  i : full (unmasked) block
+  j == i : causal block
+  j >  i : fully masked (skipped via zero-weight contribution)
+
+Used inside shard_map (manual axis) — see ``make_ring_attention_fn`` for a
+GPT-pluggable closure — and differentiable end to end (the transpose of
+ppermute is the reverse rotation, giving the standard ring-attention
+backward communication pattern for free).
+"""
+import functools
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e9
+
+
+def _local_attention_stats(q, k, v, mask_mode: int, q_chunk: int,
+                           k_chunk: int, chunk_len: int):
+    """Blockwise attention returning (numerator, row-max, row-sum).
+
+    q: (B, Sq, H, D); k/v: (B, Sk, H, D).
+    mask_mode: 0 = full, 1 = causal-with-offset, 2 = masked-out.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    s = s / np.sqrt(d)
+    if mask_mode == 1:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                          # (B,H,Sq)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                          # (B,H,Sq)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return num, m, l
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Must be called inside a shard_map manual over ``axis_name``; q/k/v are
+    the local sequence shards (B, S_local, H, D).
+    """
+    axis_size = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, s_local, h, d = q.shape
+
+    # ring: step t processes the k/v chunk originally from rank
+    # (my_idx - t) mod n, then forwards its current chunk to rank+1.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, t):
+        k_cur, v_cur, acc, m_acc, l_acc = carry
+        src = (my_idx - t) % axis_size
+
+        def blockwise(mode):
+            return _local_attention_stats(q, k_cur, v_cur, mode, 0, 0,
+                                          s_local)
+
+        if causal:
+            num_f, m_f, l_f = blockwise(0)   # unmasked
+            num_c, m_c, l_c = blockwise(1)   # causal diagonal
+            is_diag = src == my_idx
+            keep = src < my_idx
+            num = jnp.where(is_diag, num_c,
+                            jnp.where(keep, num_f, jnp.zeros_like(num_f)))
+            m = jnp.where(is_diag, m_c,
+                          jnp.where(keep, m_f,
+                                    jnp.full_like(m_f, NEG_INF)))
+            l = jnp.where(is_diag, l_c,
+                          jnp.where(keep, l_f, jnp.zeros_like(l_f)))
+        else:
+            num, m, l = blockwise(0)
+
+        # online combine
+        m_new = jnp.maximum(m_acc, m)
+        alpha_acc = jnp.exp(m_acc - m_new)
+        alpha_cur = jnp.exp(m - m_new)
+        l_new = l_acc * alpha_acc + l * alpha_cur
+        # acc: (B, Sq, H, D); alphas: (B, H, Sq) -> transpose
+        a_acc = alpha_acc.transpose(0, 2, 1)[..., None]
+        a_cur = alpha_cur.transpose(0, 2, 1)[..., None]
+        acc = acc * a_acc + num.astype(jnp.float32) * a_cur
+        # rotate k/v to the next rank
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, acc, m_new, l_new), None
+
+    acc0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_local), jnp.float32)
+    (k_f, v_f, acc, m_f, l_f), _ = lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(axis_size))
+    l_f = jnp.maximum(l_f, 1e-20).transpose(0, 2, 1)[..., None]
+    return (acc / l_f).astype(q.dtype)
+
+
+def make_ring_attention_fn(mesh, sp_axis: str):
+    """Build an attention fn (q, k, v, causal=...) -> out that runs ring
+    attention with the sequence dim sharded over ``sp_axis``.
+
+    Plugs into ``GPTConfig(attention_impl='ring', sp_axis=...)``: shard_map
+    manual over the sp axis only; batch/head dims stay automatic.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def attention(q, k, v, *, causal: bool = True, offset: int = 0):
+        del offset
+
+        def inner(q_, k_, v_):
+            return ring_attention(q_, k_, v_, axis_name=sp_axis,
+                                  causal=causal)
+
+        sm = jax.shard_map(inner,
+                           mesh=mesh,
+                           in_specs=(P(None, sp_axis), P(None, sp_axis),
+                                     P(None, sp_axis)),
+                           out_specs=P(None, sp_axis),
+                           axis_names={sp_axis},
+                           check_vma=False)
+        return sm(q, k, v)
+
+    return attention

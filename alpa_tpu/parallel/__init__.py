@@ -1,0 +1,7 @@
+"""Single-program (SPMD) parallelism building blocks.
+
+Unlike ``pipeline_parallel/`` (the Alpa-style multi-executable pipeshard
+runtime), these express pipeline/sequence/expert parallelism as collective
+programs inside ONE jit — the idiomatic TPU formulation where XLA sees the
+whole step and overlaps collectives with compute.
+"""

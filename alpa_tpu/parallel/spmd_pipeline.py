@@ -1,0 +1,132 @@
+"""Single-program SPMD pipeline parallelism (GPipe over a mesh axis).
+
+The reference has no analog: its pipeline is a multi-binary Ray runtime
+(SURVEY.md §2.4).  On TPU, a pipeline can instead be compiled into ONE XLA
+program: stage weights are stacked along a leading axis sharded over the
+``pp`` mesh axis; a ``lax.scan`` over clock ticks runs every stage each
+tick on its in-flight microbatch, and activations move to the next stage
+with ``ppermute`` over ICI.  XLA overlaps the permute with compute, there
+is no per-tick host dispatch, and the whole fwd+bwd step differentiates
+through the scan (the transpose of ``ppermute`` is the reverse permute, so
+the backward pass pipelines in reverse automatically).
+
+Composition: the surrounding jit handles dp/tp via GSPMD shardings
+(``shard_map(..., axis_names={'pp'})`` leaves other mesh axes automatic).
+"""
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_pytrees(trees):
+    """Stack a list of identical pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def spmd_pipeline(stage_fn: Callable,
+                  stage_params: Any,
+                  microbatches: jnp.ndarray,
+                  *,
+                  mesh: Mesh,
+                  pp_axis: str = "pp",
+                  extra_args: Any = None):
+    """Run a GPipe pipeline over the ``pp_axis`` of ``mesh`` in one program.
+
+    Args:
+      stage_fn: ``(params_slice, x, extra) -> y`` for one pipeline stage;
+        ``x`` and ``y`` must have identical shape/dtype.  Called inside a
+        partial-manual shard_map: dp/tp axes remain automatic inside.
+      stage_params: pytree whose leaves have leading dim ``S`` (= pp size),
+        entry s holding stage s's weights.  Sharded over ``pp_axis``.
+      microbatches: ``[n_mb, ...]`` stacked microbatch activations.
+      extra_args: broadcast pytree passed to every stage (e.g. masks).
+
+    Returns:
+      ``[n_mb, ...]`` stacked outputs of the last stage (valid on every
+      device; materialized with a masked psum over ``pp_axis``).
+    """
+    S = mesh.shape[pp_axis]
+    n_mb = microbatches.shape[0]
+    T = n_mb + S - 1
+
+    def pipelined(params, mbs, extra):
+        # leaves arrive with leading dim 1 (this rank's stage); drop it.
+        params = jax.tree_util.tree_map(lambda x: x[0], params)
+        rank = lax.axis_index(pp_axis)
+        is_first = rank == 0
+        is_last = rank == S - 1
+
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            recv, outputs = carry
+            mb_idx = jnp.clip(t - 0, 0, n_mb - 1)
+            first_in = lax.dynamic_index_in_dim(mbs, mb_idx, axis=0,
+                                                keepdims=False)
+            x = jnp.where(is_first, first_in, recv)
+            y = stage_fn(params, x, extra)
+            # shift activations to the next stage
+            nxt = lax.ppermute(y, pp_axis, fwd_perm)
+            out_idx = jnp.clip(t - (S - 1), 0, n_mb - 1)
+            write = jnp.logical_and(is_last, t >= S - 1)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs,
+                jnp.where(write,
+                          y.astype(outputs.dtype),
+                          lax.dynamic_index_in_dim(outputs, out_idx, 0,
+                                                   keepdims=False)),
+                out_idx, 0)
+            return (nxt, outputs), None
+
+        recv0 = jnp.zeros_like(microbatches[0])
+        outputs0 = jnp.zeros_like(mbs)
+        (recv, outputs), _ = lax.scan(tick, (recv0, outputs0),
+                                      jnp.arange(T))
+        # only the last rank holds real outputs; share them over pp
+        outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+        outputs = lax.psum(outputs, pp_axis)
+        return outputs
+
+    sm = jax.shard_map(pipelined,
+                       mesh=mesh,
+                       in_specs=(P(pp_axis), P(), P()),
+                       out_specs=P(),
+                       axis_names={pp_axis},
+                       check_vma=False)
+    return sm(stage_params, microbatches, extra_args)
+
+
+def pipeline_train_step_builder(embed_fn: Callable,
+                                stage_fn: Callable,
+                                head_loss_fn: Callable,
+                                *,
+                                mesh: Mesh,
+                                pp_axis: str = "pp",
+                                num_micro_batches: int = 1):
+    """Build a full pipelined train-step loss:
+
+      loss(params, batch) = head_loss(pipeline(stages, embed(batch)))
+
+    ``params`` = (embed_params, stacked_stage_params, head_params).
+    embed/head run outside the shard_map (replicated over pp; dp/tp by
+    GSPMD); the block stack is pipelined.
+    """
+
+    def loss_fn(params, batch):
+        embed_params, stage_params, head_params = params
+        x = embed_fn(embed_params, batch)  # [B, ...]
+        B = x.shape[0]
+        assert B % num_micro_batches == 0
+        mbs = x.reshape((num_micro_batches, B // num_micro_batches) +
+                        x.shape[1:])
+        y = spmd_pipeline(stage_fn, stage_params, mbs, mesh=mesh,
+                          pp_axis=pp_axis)
+        y = y.reshape((B,) + y.shape[2:])
+        return head_loss_fn(head_params, y, batch)
+
+    return loss_fn

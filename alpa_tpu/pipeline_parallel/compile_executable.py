@@ -106,6 +106,22 @@ def compile_pipeshard_executable(fun: Callable,
         _has_grad_marker(e) for e in closed_jaxpr.jaxpr.eqns)
 
     if inference_mode:
+        # Forward-only functions never pass through alpa_tpu.grad, so the
+        # layer transform must be applied here to get layer markers.
+        from alpa_tpu.pipeline_parallel.layer_construction import (
+            layer_level_transform)
+        from alpa_tpu.pipeline_parallel.primitive_def import pipeline_p
+        has_markers = any(
+            e.primitive is pipeline_p and e.params["mark_type"] == "start"
+            for e in closed_jaxpr.jaxpr.eqns)
+        if not has_markers:
+            transformed = layer_level_transform(fun, layer_option)
+            closed_jaxpr = jax.make_jaxpr(
+                lambda *a: transformed(*a))(*micro_avals)
+            global_invars = list(closed_jaxpr.jaxpr.invars)
+            global_outvars = list(closed_jaxpr.jaxpr.outvars)
+            consts_map = dict(
+                zip(closed_jaxpr.jaxpr.constvars, closed_jaxpr.consts))
         return _compile_inference(fun, virtual_mesh, closed_jaxpr, in_avals,
                                   micro_avals, in_tree, batch_invars,
                                   num_micro_batches, as_option,
@@ -171,6 +187,11 @@ def compile_pipeshard_executable(fun: Callable,
 
     # ---- gradient accumulation rewrite ----
     all_stages = fwd_stages + bwd_stages
+    # Merged stages export the union of their member layers' outvars,
+    # including intra-stage activations; prune to values actually consumed
+    # outside the stage (other stages, gradients, global outputs) so they
+    # are neither materialized nor held across microbatches.
+    _prune_stage_outvars(all_stages, grad_pairs, global_outvars)
     # ensure every grad pre-var is exported by some stage
     _export_vars(all_stages, [p for p, _ in grad_pairs])
     all_stages, acc_info = compute_grad_to_accumulate_grad(
@@ -249,6 +270,22 @@ def compile_pipeshard_executable(fun: Callable,
 def _has_grad_marker(eqn) -> bool:
     from alpa_tpu.pipeline_parallel.primitive_def import is_marker
     return is_marker(eqn, "grad")
+
+
+def _prune_stage_outvars(stages: List[JaxPipelineComputation], grad_pairs,
+                         global_outvars):
+    external = set(p for p, _ in grad_pairs)
+    external.update(v for v in global_outvars if isinstance(v, Var))
+    invars_of = [set(s.invars) for s in stages]
+    for i, comp in enumerate(stages):
+        used_elsewhere = set()
+        for j, inv in enumerate(invars_of):
+            if j != i:
+                used_elsewhere |= inv
+        comp.outvars = [
+            v for v in comp.outvars
+            if v in external or v in used_elsewhere
+        ]
 
 
 def _export_vars(stages: List[JaxPipelineComputation], needed: Sequence[Var]):

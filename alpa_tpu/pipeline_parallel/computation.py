@@ -166,7 +166,6 @@ def mark_missing_vars_in_backward_computation_pipeline_marks(
         for e in comp.eqns:
             for v in e.outvars:
                 defined_by[v] = ci
-    global_set = set(global_invars)
     for ci, comp in enumerate(computations):
         known = OrderedSet(comp.invars)
         defined_here = OrderedSet()

@@ -16,7 +16,7 @@ The transform applies to the *loss function* before differentiation:
 import dataclasses
 import logging
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -96,37 +96,65 @@ def slice_eqns_by_boundary(closed_jaxpr: ClosedJaxpr) -> List[List]:
     return groups
 
 
+HEAVY_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def _segment_eqns(eqns) -> List[Tuple[int, int]]:
+    """Coarsen eqns into segments that each end right after a heavy op —
+    the only sensible layer cut points.  Keeps the DP at O(#dots^2 * k)
+    instead of O(#eqns^2 * k)."""
+    bounds = []
+    start = 0
+    for i, e in enumerate(eqns):
+        if e.primitive.name in HEAVY_PRIMS:
+            bounds.append((start, i + 1))
+            start = i + 1
+    if start < len(eqns):
+        bounds.append((start, len(eqns)))
+    return bounds
+
+
 def cluster_eqns_by_cost(closed_jaxpr: ClosedJaxpr, layer_num: int,
                          eps: float = 0.6) -> List[List]:
     """DP clustering of eqns into ``layer_num`` contiguous groups.
 
     Re-derivation of ref ``cluster_jaxpr_by_cost`` (layer_construction.py:
     342-422): minimize cross-layer transferred bytes subject to each layer's
-    flops <= (1 + eps) * (total / layer_num).  DP over (eqn index, layers
-    used) with O(n^2 k) transitions; n is kept manageable by grouping at
-    "heavy op" granularity.
+    flops <= (1 + eps) * (total / layer_num).  The DP runs over heavy-op
+    segments (cut points only after dots/convs), not raw eqns.
     """
-    eqns = closed_jaxpr.jaxpr.eqns
+    all_eqns = closed_jaxpr.jaxpr.eqns
+    if len(all_eqns) == 0 or layer_num <= 1:
+        return [list(all_eqns)]
+    segments = _segment_eqns(all_eqns)
+    # treat each segment as one "super eqn"
+    eqns = segments
     n = len(eqns)
-    if n == 0 or layer_num <= 1:
-        return [list(eqns)]
-    flops = np.array([jaxpr_eqn_flops(e) for e in eqns])
+    if n <= layer_num:
+        return [list(all_eqns[a:b]) for a, b in segments]
+    flops = np.array([
+        sum(jaxpr_eqn_flops(e) for e in all_eqns[a:b]) for a, b in segments
+    ])
     total = flops.sum()
     budget = (1 + eps) * total / layer_num
 
     # cumulative flops for O(1) range cost
     cum = np.concatenate([[0], np.cumsum(flops)])
 
-    # outgoing bytes if we cut after eqn i: vars defined at <= i used at > i
+    # outgoing bytes if we cut after segment i: vars defined in seg <= i
+    # used in seg > i
+    seg_of = np.zeros(len(all_eqns), dtype=int)
+    for si, (a, b) in enumerate(segments):
+        seg_of[a:b] = si
     defined_at = {}
-    for i, e in enumerate(eqns):
+    for i, e in enumerate(all_eqns):
         for v in e.outvars:
-            defined_at[v] = i
+            defined_at[v] = seg_of[i]
     last_use = {}
-    for i, e in enumerate(eqns):
+    for i, e in enumerate(all_eqns):
         for v in e.invars:
             if isinstance(v, Var) and v in defined_at:
-                last_use[v] = i
+                last_use[v] = seg_of[i]
     for v in closed_jaxpr.jaxpr.outvars:
         if isinstance(v, Var) and v in defined_at:
             last_use[v] = n
@@ -155,9 +183,12 @@ def cluster_eqns_by_cost(closed_jaxpr: ClosedJaxpr, layer_num: int,
                 if c < f[k][i]:
                     f[k][i] = c
                     arg[k][i] = j
+    def _segs_to_eqns(seg_lo: int, seg_hi: int):
+        return list(all_eqns[segments[seg_lo][0]:segments[seg_hi - 1][1]])
+
     if f[layer_num][n] == INF:
-        # fall back to equal-flops split
-        return _equal_flops_split(eqns, flops, layer_num)
+        # fall back to equal-flops split over segments
+        return _equal_flops_split(all_eqns, segments, flops, layer_num)
     # backtrack
     cuts = []
     i = n
@@ -166,15 +197,15 @@ def cluster_eqns_by_cost(closed_jaxpr: ClosedJaxpr, layer_num: int,
         cuts.append((j, i))
         i = j
     cuts.reverse()
-    return [list(eqns[a:b]) for a, b in cuts if b > a]
+    return [_segs_to_eqns(a, b) for a, b in cuts if b > a]
 
 
-def _equal_flops_split(eqns, flops, layer_num):
+def _equal_flops_split(all_eqns, segments, flops, layer_num):
     total = flops.sum()
     target = total / layer_num
     groups, cur, acc = [], [], 0.0
-    for e, fl in zip(eqns, flops):
-        cur.append(e)
+    for (a, b), fl in zip(segments, flops):
+        cur.extend(all_eqns[a:b])
         acc += fl
         if acc >= target and len(groups) < layer_num - 1:
             groups.append(cur)
@@ -205,7 +236,6 @@ def add_pipeline_marks_for_sliced_eqns(closed_jaxpr: ClosedJaxpr,
     global_invars = OrderedSet(jaxpr.invars)
     global_consts = OrderedSet(jaxpr.constvars)
 
-    defined_in_layer = []  # var -> layer idx
     var_layer = {}
     for li, group in enumerate(sliced_eqns):
         for e in group:
@@ -287,9 +317,8 @@ def layer_level_transform(fn: Callable, layer_option: LayerOption) -> Callable:
         else:
             sliced = slice_eqns_by_boundary(closed_jaxpr)
         marked = add_pipeline_marks_for_sliced_eqns(closed_jaxpr, sliced)
-        run = jaxpr_as_fun(marked)
-        if layer_option.remat_layer:
-            run = _remat_by_layer(marked)
+        run = (_remat_by_layer(marked) if layer_option.remat_layer
+               else jaxpr_as_fun(marked))
         flat_args = jax.tree_util.tree_leaves((args, kwargs))
         out_flat = run(*flat_args)
         return jax.tree_util.tree_unflatten(out_tree, out_flat)

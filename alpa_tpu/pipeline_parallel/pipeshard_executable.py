@@ -153,13 +153,26 @@ class PipeshardDriverExecutable:
                                 as_option, logical_shapes[s], donate))
         self.num_fwd_stages = len(fwd_stages)
         self.has_bwd = len(bwd_stages) > 0
-        apply_offset = len(self.stage_execs)
+        # Donate state inputs (params/opt state) to the apply executables
+        # that consume them exactly once — realizes the caller's
+        # donate_argnums contract so old and new state never coexist.
+        donated_global = {
+            v for v, d in zip(global_invars, donated_invars) if d
+        }
+        use_count: Dict[Var, int] = {}
+        for comp in apply_comps:
+            for v in comp.invars:
+                use_count[v] = use_count.get(v, 0) + 1
         self.apply_execs: List[Optional[StageExecutable]] = []
         for m, comp in enumerate(apply_comps):
             if comp.eqns or comp.outvars:
+                donate = [
+                    i for i, v in enumerate(comp.invars)
+                    if v in donated_global and use_count.get(v) == 1
+                ]
                 self.apply_execs.append(
                     StageExecutable(comp.name, comp, m, self.mesh_group[m],
-                                    as_option, logical_shapes[m], []))
+                                    as_option, logical_shapes[m], donate))
             else:
                 self.apply_execs.append(None)
         if global_config.print_compilation_time:
@@ -389,6 +402,7 @@ class PipeshardDriverExecutable:
                     protected.add((v, mb, m))
         self.instructions = emit_free_instructions(instructions, protected)
         self._const_cache = None
+        self._zero_exec_cache = None
 
     # ------------------------------------------------------------------
     # execution
@@ -432,11 +446,26 @@ class PipeshardDriverExecutable:
         for v, slot in self._const_cache.items():
             env[(v, -1)] = dict(slot)
 
-        # zero accumulators
-        for v, mesh_id, aval, sharding in self.acc_allocs:
-            buf = alloc_zero_buffers(self.mesh_group[mesh_id], [aval],
-                                     [sharding])[0]
-            env.setdefault((v, -1), {})[mesh_id] = buf
+        # zero accumulators (compiled once, reused every step)
+        if self._zero_exec_cache is None:
+            self._zero_exec_cache = []
+            by_mesh: Dict[int, List] = {}
+            for v, mesh_id, aval, sharding in self.acc_allocs:
+                by_mesh.setdefault(mesh_id, []).append((v, aval, sharding))
+            for mesh_id, items in by_mesh.items():
+                avals = [a for _, a, _ in items]
+                shardings = [s for _, _, s in items]
+                compiled = (jax.jit(
+                    lambda avs=tuple(avals): [
+                        jnp.zeros(a.shape, a.dtype) for a in avs
+                    ],
+                    out_shardings=shardings).lower().compile())
+                self._zero_exec_cache.append(
+                    (mesh_id, [v for v, _, _ in items], compiled))
+        for mesh_id, vs, compiled in self._zero_exec_cache:
+            bufs = compiled()
+            for v, buf in zip(vs, bufs):
+                env.setdefault((v, -1), {})[mesh_id] = buf
 
         # interpret
         collect = global_config.collect_trace
@@ -482,15 +511,24 @@ class PipeshardDriverExecutable:
                 outs.append(env[k][m])
             elif kind == "input":
                 outs.append(flat_args[payload])
-            else:  # concat over microbatches
+            else:  # concat over microbatches (inference outputs)
                 v, meshes = payload
                 vals = [env[(v, mb)][m] for mb, m in meshes]
-                if vals[0].ndim >= 1 and n_mb > 1:
-                    host = [jax.device_put(x, self.mesh_group[meshes[0][1]]
-                                           .flat_devices[0]) for x in vals]
-                    outs.append(jnp.concatenate(host, axis=0))
-                else:
+                if n_mb == 1:
                     outs.append(vals[0])
+                elif vals[0].ndim >= 1:
+                    # axis 0 must be the (microbatched) batch dim
+                    outs.append(jnp.concatenate(
+                        [jax.device_put(
+                            x, self.mesh_group[meshes[0][1]]
+                            .flat_devices[0]) for x in vals], axis=0))
+                else:
+                    raise ValueError(
+                        "A scalar output of a pipelined forward-only "
+                        "function is ambiguous with num_micro_batches > 1 "
+                        "(per-microbatch reduction cannot be recombined); "
+                        "return per-example values or use "
+                        "num_micro_batches=1.")
         timer.stop()
         return outs
 

@@ -194,3 +194,44 @@ class TestPipeshardGPT:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestPipeshardInference:
+
+    def test_pipelined_forward_only(self):
+        from alpa_tpu.testing import create_mlp_train_state_and_batch
+
+        alpa_tpu.init(cluster="local")
+        state, batch = create_mlp_train_state_and_batch(batch_size=64,
+                                                        num_layers=4)
+
+        @alpa_tpu.parallelize(method=PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=2),
+            stage_option=UniformStageOption(num_stages=2),
+            pipeline_schedule="inference"), batch_argnums=(1,))
+        def forward(state, batch):
+            return state.apply_fn(state.params, batch["x"])
+
+        out = forward(state, batch)
+        ref = state.apply_fn(state.params, batch["x"])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=1e-5)
+
+    def test_scalar_output_with_microbatching_errors(self):
+        from alpa_tpu.testing import create_mlp_train_state_and_batch
+
+        alpa_tpu.init(cluster="local")
+        state, batch = create_mlp_train_state_and_batch(batch_size=64,
+                                                        num_layers=4)
+
+        @alpa_tpu.parallelize(method=PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=2),
+            stage_option=UniformStageOption(num_stages=2),
+            pipeline_schedule="inference"), batch_argnums=(1,))
+        def mean_out(state, batch):
+            return jnp.mean(state.apply_fn(state.params, batch["x"]))
+
+        with pytest.raises(ValueError, match="scalar output"):
+            mean_out(state, batch)
