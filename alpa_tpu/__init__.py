@@ -21,7 +21,18 @@ from alpa_tpu.parallel_method import (DataParallel, LocalPipelineParallel,
                                       ParallelMethod, PipeshardParallel,
                                       ShardParallel, Zero2Parallel,
                                       Zero3Parallel, get_3d_parallel_method)
+from alpa_tpu.create_state_parallel import CreateStateParallel
+from alpa_tpu.data_loader import DataLoader, MeshDriverDataLoader
+from alpa_tpu.follow_parallel import FollowParallel
+from alpa_tpu.parallel_plan import (ParallelPlan, executable_to_plan,
+                                    plan_to_method)
+from alpa_tpu.pipeline_parallel.layer_construction import (AutoLayerOption,
+                                                           ManualLayerOption)
 from alpa_tpu.pipeline_parallel.primitive_def import (mark_pipeline_boundary)
+from alpa_tpu.pipeline_parallel.stage_construction import (AutoStageOption,
+                                                           ManualStageOption,
+                                                           UniformStageOption)
+from alpa_tpu.serialization import (restore_checkpoint, save_checkpoint)
 from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
 from alpa_tpu.shard_parallel.manual_sharding import ManualShardingOption
 from alpa_tpu.timer import timers, tracer

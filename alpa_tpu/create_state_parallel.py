@@ -1,0 +1,85 @@
+"""CreateStateParallel: initialize the train state *already sharded*.
+
+Analog of ref ``alpa/create_state_parallel.py`` (SURVEY.md §2.1): the state
+initialization function is compiled with output shardings copied from an
+already-compiled train step's input placement, so big models materialize
+directly in their distributed layout (never unsharded on one host).
+"""
+import logging
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+from alpa_tpu.mesh_executable import NormalMeshExecutable
+from alpa_tpu.parallel_method import ParallelMethod
+
+logger = logging.getLogger(__name__)
+
+
+class CreateStateParallel(ParallelMethod):
+    """method=CreateStateParallel(train_step, state_example_args) for
+    ``parallelize``-ing an init function (ref CreateStateParallel:336).
+
+    ``train_step`` must be a ParallelizedFunc already compiled (or
+    compilable) whose first argument is the state.
+    """
+
+    def __init__(self, train_step, train_step_args: Sequence[Any]):
+        self.train_step = train_step
+        self.train_step_args = train_step_args
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        # Compile/fetch the target executable to read its input placement.
+        executable, _ = self.train_step.get_executable(
+            *self.train_step_args)
+
+        from alpa_tpu.pipeline_parallel.pipeshard_executable import (
+            PipeshardDriverExecutable)
+        if isinstance(executable, PipeshardDriverExecutable):
+            return _compile_create_state_pipeshard(fun, in_avals,
+                                                   executable)
+        # ShardParallel target: state leaves are the leading invars of the
+        # train step; their shardings become our output shardings.
+        n_out = len(jax.tree_util.tree_leaves(
+            jax.eval_shape(fun, *in_avals)))
+        out_shardings = list(executable.in_shardings[:n_out])
+        jitted = jax.jit(fun, out_shardings=out_shardings)
+        lowered = jitted.lower(*in_avals)
+        compiled = lowered.compile()
+        return NormalMeshExecutable(
+            executable.physical_mesh, compiled,
+            in_avals=in_avals, out_avals=None,
+            in_shardings=[None] * len(in_avals),
+            out_shardings=out_shardings,
+            in_tree=in_tree, out_tree=None)
+
+
+def _compile_create_state_pipeshard(fun, in_avals, pipeshard_exec):
+    """Pipeshard target: every state leaf must materialize on the mesh its
+    consuming stage lives on (ref compile_create_state_executable:73 /
+    propagate_mesh_assignment:151)."""
+
+    class _CreateStatePipeshardExecutable:
+
+        def __init__(self):
+            self.out_tree = None
+            self.in_avals = in_avals
+
+        def launch_on_driver(self, *flat_args):
+            outs_host = jax.jit(fun)(*flat_args)
+            # place each leaf per the pipeshard input placement
+            flat_outs = list(outs_host)
+            placed = []
+            gin = pipeshard_exec.global_invars
+            place = pipeshard_exec.input_place
+            for i, x in enumerate(flat_outs):
+                v = gin[i] if i < len(gin) else None
+                if v is not None and v in place:
+                    mesh_id, sharding = place[v][0]
+                    placed.append(jax.device_put(x, sharding))
+                else:
+                    placed.append(x)
+            return placed
+
+    return _CreateStatePipeshardExecutable()

@@ -1,0 +1,62 @@
+"""FollowParallel: parallelize a function following another's placement.
+
+Analog of ref ``alpa/follow_parallel.py`` (SURVEY.md §2.1): compile e.g. an
+eval/inference step whose inputs reuse the sharding layout chosen for the
+train step, so no resharding happens between train and eval calls.
+"""
+import logging
+from typing import Any, Optional, Sequence
+
+import jax
+
+from alpa_tpu.mesh_executable import NormalMeshExecutable
+from alpa_tpu.parallel_method import ParallelMethod
+
+logger = logging.getLogger(__name__)
+
+
+class FollowParallel(ParallelMethod):
+    """method=FollowParallel(train_step, train_step_args)
+    (ref compile_follow_parallel_executable, follow_parallel.py:25)."""
+
+    def __init__(self, src_func, src_args: Sequence[Any],
+                 num_micro_batches: Optional[int] = None):
+        self.src_func = src_func
+        self.src_args = src_args
+        self.num_micro_batches = num_micro_batches
+
+    def compile_executable(self, fun, in_avals, in_tree, in_paths,
+                           donated_invars, batch_invars):
+        src_exec, _ = self.src_func.get_executable(*self.src_args)
+        from alpa_tpu.pipeline_parallel.pipeshard_executable import (
+            PipeshardDriverExecutable)
+        if isinstance(src_exec, PipeshardDriverExecutable):
+            raise NotImplementedError(
+                "FollowParallel after a pipeshard executable is not wired "
+                "yet; follow a ShardParallel executable or use "
+                "PipeshardParallel with stage_input_shardings.")
+
+        # Match our inputs to the source executable's inputs by
+        # (shape, dtype): shared leaves (params/state) reuse the source
+        # sharding; unmatched args (e.g. a different batch) stay unset.
+        import numpy as np
+        pool = {}
+        for aval, s in zip(src_exec.in_avals, src_exec.in_shardings):
+            pool.setdefault((tuple(aval.shape), np.dtype(aval.dtype)),
+                            []).append(s)
+        in_shardings = []
+        for aval in in_avals:
+            lst = pool.get((tuple(aval.shape), np.dtype(aval.dtype)))
+            in_shardings.append(lst.pop(0) if lst else None)
+
+        jitted = jax.jit(fun, in_shardings=tuple(in_shardings))
+        compiled = jitted.lower(*in_avals).compile()
+        return NormalMeshExecutable(
+            src_exec.physical_mesh, compiled,
+            in_avals=in_avals, out_avals=None,
+            in_shardings=[
+                s if s is not None else c for s, c in zip(
+                    in_shardings, compiled.input_shardings[0])
+            ],
+            out_shardings=list(compiled.output_shardings),
+            in_tree=in_tree, out_tree=None)

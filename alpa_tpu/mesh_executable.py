@@ -110,10 +110,11 @@ class NormalMeshExecutable(MeshExecutable):
         """
         timer = timers(self.timer_name + "-dispatch")
         timer.start()
-        args = self._prepare_args(flat_args)
-        out = self.compiled(*args)
-        timer.stop()
-        return out
+        try:
+            args = self._prepare_args(flat_args)
+            return self.compiled(*args)
+        finally:
+            timer.stop()
 
     def _prepare_args(self, flat_args):
         """Commit plain host arrays to the mesh per the input shardings.

@@ -1,0 +1,194 @@
+"""Mesh profiling database + static cost estimation.
+
+Analog of ref ``alpa/mesh_profiling.py`` (SURVEY.md §2.8): the cost-model
+side of auto stage construction.  Two paths, like the reference:
+
+* ``ProfilingResultDatabase`` — measured dot/collective costs per mesh
+  signature, picklable, filled by ``profile_all`` on real hardware
+  (ref ProfilingResultDatabase:162 / profile_all:725).
+* ``estimate_stage_cost`` — pure static model (ref
+  ``estimate_hlo_module_cost:901`` / HloCostModelProfileWorker): analytic
+  flops / collective alpha-beta over the LogicalDeviceMesh, used as the
+  default on TPU where spinning up submeshes to profile is slow
+  (SURVEY.md §7 hard part 2).
+"""
+import logging
+import pickle
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from alpa_tpu.device_mesh import LogicalDeviceMesh
+from alpa_tpu.util import benchmark_func, jaxpr_eqn_flops
+
+logger = logging.getLogger(__name__)
+
+# Rough per-chip peak for cost normalization (abstract units are fine: the
+# DP only compares costs; absolute scale cancels).  Seconds per flop.
+DEFAULT_SEC_PER_FLOP = 1.0 / 100e12
+
+
+class MeshProfilingResult:
+    """Measured costs for one mesh signature (ref MeshProfilingResult:18)."""
+
+    def __init__(self):
+        # op name -> list[(size, seconds)]
+        self.dot_cost_dict: Dict[Tuple, List] = {}
+        self.all_reduce_cost_dict: Dict[Tuple, List] = {}
+        self.all_gather_cost_dict: Dict[Tuple, List] = {}
+        self.reduce_scatter_cost_dict: Dict[Tuple, List] = {}
+        self.all_to_all_cost_dict: Dict[Tuple, List] = {}
+
+    def record(self, kind: str, key: Tuple, size: int, seconds: float):
+        getattr(self, f"{kind}_cost_dict").setdefault(key, []).append(
+            (size, seconds))
+
+    def estimate(self, kind: str, key: Tuple, size: int) -> Optional[float]:
+        """Linear interpolation on measured (size, time) points."""
+        points = getattr(self, f"{kind}_cost_dict").get(key)
+        if not points:
+            return None
+        points = sorted(points)
+        sizes = np.array([p[0] for p in points], dtype=float)
+        times = np.array([p[1] for p in points], dtype=float)
+        return float(np.interp(size, sizes, times))
+
+
+class ProfilingResultDatabase:
+    """cluster-signature -> MeshProfilingResult (ref :162)."""
+
+    def __init__(self, data: Optional[Dict] = None):
+        self.data: Dict[str, MeshProfilingResult] = data or {}
+
+    def query(self, cluster_key: str) -> Optional[MeshProfilingResult]:
+        return self.data.get(cluster_key)
+
+    def update_one_mesh(self, cluster_key: str,
+                        result: MeshProfilingResult):
+        self.data[cluster_key] = result
+
+    def save(self, filename: str):
+        with open(filename, "wb") as f:
+            pickle.dump(self.data, f)
+
+    @classmethod
+    def load(cls, filename: str) -> "ProfilingResultDatabase":
+        with open(filename, "rb") as f:
+            return cls(pickle.load(f))
+
+
+def profile_one_mesh(physical_mesh,
+                     sizes=(1 << 16, 1 << 20, 1 << 24)) -> MeshProfilingResult:
+    """Measure matmul + collective times on a live mesh
+    (ref profile_one_hlo_op:392, simplified: jit-timed instead of
+    while-loop executables)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    result = MeshProfilingResult()
+    mesh = physical_mesh.get_jax_mesh(("x",),
+                                      (physical_mesh.num_devices,))
+    # dots
+    for n in (1024, 4096):
+        a = jnp.zeros((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a: a @ a)
+        cost = benchmark_func(lambda: jax.block_until_ready(f(a)),
+                              warmup=1, repeat=2, number=3).mean()
+        result.record("dot", ("bf16",), 2 * n**3, cost)
+    # collectives
+    if physical_mesh.num_devices > 1:
+        for size in sizes:
+            x = jax.device_put(
+                jnp.zeros((size // 4,), jnp.float32),
+                NamedSharding(mesh, P("x")))
+
+            def ag(x):
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P()))
+
+            f = jax.jit(ag)
+            cost = benchmark_func(lambda: jax.block_until_ready(f(x)),
+                                  warmup=1, repeat=2, number=3).mean()
+            result.record("all_gather", ("f32",), size, cost)
+    return result
+
+
+def profile_all(cluster, filename: Optional[str] = None
+                ) -> ProfilingResultDatabase:
+    """Profile the whole cluster (ref profile_all:725)."""
+    db = ProfilingResultDatabase()
+    mesh = cluster.get_physical_mesh()
+    key = f"{mesh.num_hosts}x{mesh.num_devices_per_host}"
+    db.update_one_mesh(key, profile_one_mesh(mesh))
+    if filename:
+        db.save(filename)
+    return db
+
+
+########################################
+# static stage cost model
+########################################
+
+
+def estimate_stage_cost(stage_comps,
+                        logical_mesh: LogicalDeviceMesh,
+                        as_option,
+                        sec_per_flop: float = DEFAULT_SEC_PER_FLOP,
+                        use_ilp: bool = True) -> float:
+    """Estimate execution time of a merged stage on a logical mesh.
+
+    compute = total flops / (devices * peak); communication = the intra-op
+    strategy graph's solved ILP objective (the same alpha-beta units scaled
+    into seconds).  This replaces the reference's compile-and-profile
+    workers as the default path (HloCostModelProfileWorker analog).
+    """
+    import jax
+    from jax._src.core import jaxpr_as_fun
+
+    from alpa_tpu.pipeline_parallel.computation import merge_computations
+
+    comp = (merge_computations(stage_comps, "cost_probe")
+            if len(stage_comps) > 1 else stage_comps[0])
+    flops = sum(jaxpr_eqn_flops(e) for e in comp.eqns)
+    n_dev = logical_mesh.num_devices
+    compute_cost = flops * sec_per_flop / max(n_dev, 1)
+
+    comm_cost = 0.0
+    if use_ilp and n_dev > 1:
+        try:
+            from alpa_tpu.shard_parallel.ilp import (solution_cost,
+                                                     solve_strategy_graph)
+            from alpa_tpu.shard_parallel.strategy import build_strategy_graph
+            closed = comp.closed_jaxpr()
+            graph = build_strategy_graph(closed, [v.aval for v in comp.invars],
+                                         logical_mesh, [], as_option)
+            choice = solve_strategy_graph(graph, time_limit=10)
+            # alpha-beta units: beta=0.01 ~ 1 byte / (ICI ~100GB/s) scaled;
+            # treat one cost unit as 1e-7 s (relative ranking is what
+            # matters to the DP).
+            comm_cost = solution_cost(graph, choice) * 1e-7
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug("stage ILP cost estimate failed: %s", e)
+    return compute_cost + comm_cost
+
+
+def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
+                          num_in_flight: int = 1) -> float:
+    """Rough per-device bytes: params/devices + activations in flight."""
+    comp = stage_comps[0] if len(stage_comps) == 1 else None
+    comps = stage_comps
+    param_bytes = 0.0
+    act_bytes = 0.0
+    for c in comps:
+        for v in c.invars:
+            if hasattr(v.aval, "shape"):
+                b = float(np.prod(v.aval.shape) or 1) * v.aval.dtype.itemsize
+                param_bytes += b
+        for v in c.outvars:
+            if hasattr(v.aval, "shape"):
+                act_bytes += float(np.prod(v.aval.shape) or 1) * \
+                    v.aval.dtype.itemsize
+    n = max(logical_mesh.num_devices, 1)
+    return param_bytes / n + act_bytes * num_in_flight

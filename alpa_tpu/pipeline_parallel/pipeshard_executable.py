@@ -410,6 +410,12 @@ class PipeshardDriverExecutable:
     def launch_on_driver(self, *flat_args):
         timer = timers("pipeshard-dispatch")
         timer.start()
+        try:
+            return self._launch(*flat_args)
+        finally:
+            timer.stop()
+
+    def _launch(self, *flat_args):
         env: Dict[Tuple[Var, int], Dict[int, Any]] = {}
         n_mb = self.num_micro_batches
 
@@ -529,7 +535,6 @@ class PipeshardDriverExecutable:
                         "(per-microbatch reduction cannot be recombined); "
                         "return per-example values or use "
                         "num_micro_batches=1.")
-        timer.stop()
         return outs
 
     def __call__(self, *args):

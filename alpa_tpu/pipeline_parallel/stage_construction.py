@@ -43,10 +43,18 @@ class AutoStageOption(StageOption):
     """Search layer->stage clustering + submesh shapes with the OSDI'22 DP
     (ref :28)."""
     submesh_physical_shape_space: str = "power_of_two"
+    # NOTE: logical-shape search within each submesh is delegated to the
+    # per-stage intra-op planner's mesh-shape search; this field is kept
+    # for reference API parity and logged if set to a non-default.
     submesh_logical_shape_space: str = "single_node_model_parallel"
+    # Prune DP thresholds above tolerance * (best balanced stage cost).
     stage_imbalance_tolerance: float = np.inf
+    # False -> include the intra-op ILP objective in stage costs even for
+    # large search spaces (slower, more accurate).
     use_hlo_cost_model: bool = True
     profiling_database_filename: Optional[str] = None
+    # Per-device memory budget in bytes (None = unconstrained).
+    memory_budget_per_device: Optional[float] = None
 
 
 def get_submesh_choices(num_hosts: int, num_devices_per_host: int,
@@ -81,17 +89,24 @@ def get_sliced_virtual_submeshes(virtual_mesh: VirtualPhysicalMesh,
     total_requested = sum(int(np.prod(s)) for s in submesh_shapes)
     assert total_requested <= virtual_mesh.num_devices, (
         f"requested {total_requested} devices > {virtual_mesh.num_devices}")
-    submeshes = []
+    # Pack largest-first (whole-host slices before sub-host fragments) so
+    # fragments fill the gaps — mirrors ref stage_construction.py:536-539's
+    # size-sorted packing; results are returned in the original order.
+    order = sorted(range(len(submesh_shapes)),
+                   key=lambda i: (-int(submesh_shapes[i][0]),
+                                  -int(np.prod(submesh_shapes[i]))))
+    submeshes = [None] * len(submesh_shapes)
     host_ptr = 0
     dev_ptr = 0
-    for shape in submesh_shapes:
-        h, d = int(shape[0]), int(shape[1])
+    for i in order:
+        h, d = int(submesh_shapes[i][0]), int(submesh_shapes[i][1])
         if h > 1 or d == ndph:
             # whole-host slices
             if dev_ptr != 0:
                 host_ptr += 1
                 dev_ptr = 0
-            assert host_ptr + h <= num_hosts, "not enough hosts"
+            assert host_ptr + h <= num_hosts, (
+                f"not enough hosts packing submeshes {submesh_shapes}")
             sub = virtual_mesh.slice_2d(range(host_ptr, host_ptr + h),
                                         range(d))
             host_ptr += h
@@ -99,11 +114,12 @@ def get_sliced_virtual_submeshes(virtual_mesh: VirtualPhysicalMesh,
             if dev_ptr + d > ndph:
                 host_ptr += 1
                 dev_ptr = 0
-            assert host_ptr < num_hosts, "not enough devices"
+            assert host_ptr < num_hosts, (
+                f"not enough devices packing submeshes {submesh_shapes}")
             sub = virtual_mesh.slice_2d([host_ptr],
                                         range(dev_ptr, dev_ptr + d))
             dev_ptr += d
-        submeshes.append(sub)
+        submeshes[i] = sub
     return submeshes
 
 

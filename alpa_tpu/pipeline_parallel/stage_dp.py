@@ -1,14 +1,265 @@
-"""Auto stage construction via the OSDI'22 dynamic program.
+"""Auto stage construction: cost tensor + the OSDI'22 dynamic program.
 
-Analog of ref ``training_dp_impl`` (``stage_construction.py:235``) +
-``get_compute_cost`` (``stage_profiling.py:1163``).  The DP and the
-cost-model-based stage profiling land with the auto-stage milestone; a
-clear error guards the entry until then.
+Analog of ref ``get_compute_cost`` (stage_profiling.py:1163) +
+``training_dp`` (stage_construction.py:235-311).  The compute-cost tensor
+C[i, j, m] (layers i..j on submesh choice m) is filled by the static cost
+model (mesh_profiling.estimate_stage_cost — the HloCostModelProfileWorker
+analog, default on TPU) and the DP minimizing
+``sum(stage costs) + (B-1) * max(stage cost)`` runs in native C++
+(csrc/stage_dp.cc, built to alpa_tpu/_native/libstage_dp.so) with a pure
+Python fallback.
 """
+import ctypes
+import logging
+import os
+import subprocess
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from alpa_tpu.global_env import global_config
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "_native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libstage_dp.so")
+_CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_NATIVE_DIR)),
+                         "csrc")
+
+_lib = None
+_lib_tried = False
+
+
+def _load_native():
+    """Load (building if needed) the C++ DP solver."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        makefile = os.path.join(_CSRC_DIR, "Makefile")
+        if os.path.exists(makefile):
+            try:
+                subprocess.run(["make", "-C", _CSRC_DIR], check=True,
+                               capture_output=True, timeout=120)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning("building libstage_dp.so failed: %s", e)
+    if os.path.exists(_LIB_PATH):
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.stage_dp_solve.restype = ctypes.c_int
+            lib.stage_dp_solve.argtypes = [
+                ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int32,
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                ctypes.c_double,
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ]
+            _lib = lib
+        except OSError as e:
+            logger.warning("loading libstage_dp.so failed: %s", e)
+    return _lib
+
+
+def stage_dp_solve(costs: np.ndarray,
+                   submesh_sizes: Sequence[int],
+                   num_devices: int,
+                   num_micro_batches: int,
+                   mem: Optional[np.ndarray] = None,
+                   mem_budget: float = 0.0
+                   ) -> Optional[List[Tuple[int, int, int]]]:
+    """Solve the stage-construction DP.
+
+    costs: (L, L, M) float64; costs[i, j, m] = cost of layers i..j (incl.)
+    on submesh m (inf = infeasible).  Returns list of
+    (start_layer, end_layer_exclusive, submesh_idx) or None if infeasible.
+    """
+    L, _, M = costs.shape
+    costs = np.ascontiguousarray(costs, np.float64)
+    sizes = np.ascontiguousarray(submesh_sizes, np.int64)
+    if mem is None:
+        mem = np.zeros_like(costs)
+    mem = np.ascontiguousarray(mem, np.float64)
+
+    lib = _load_native()
+    if lib is not None:
+        starts = np.zeros(L, np.int32)
+        meshes = np.zeros(L, np.int32)
+        S = lib.stage_dp_solve(L, M, num_devices, num_micro_batches, costs,
+                               sizes, mem, mem_budget, starts, meshes)
+        if S < 0:
+            return None
+        out = []
+        for t in range(S):
+            end = starts[t + 1] if t + 1 < S else L
+            out.append((int(starts[t]), int(end), int(meshes[t])))
+        return out
+    return _stage_dp_python(costs, sizes, num_devices, num_micro_batches,
+                            mem, mem_budget)
+
+
+def _stage_dp_python(C, sizes, D, B, mem, mem_budget):
+    """Pure-Python fallback, same algorithm as csrc/stage_dp.cc."""
+    L, _, M = C.shape
+    INF = float("inf")
+    finite = C[np.isfinite(C)]
+    if finite.size == 0:
+        return None
+    candidates = np.unique(finite)
+    best_obj, best_part = INF, None
+
+    for t_max in candidates:
+        if best_part is not None and (B - 1) * t_max >= best_obj:
+            break
+        f = np.full((L + 1, D + 1), INF)
+        cj = np.full((L + 1, D + 1), -1, np.int32)
+        cm = np.full((L + 1, D + 1), -1, np.int32)
+        f[L][0] = 0.0
+        for l in range(L - 1, -1, -1):
+            for d in range(1, D + 1):
+                for j in range(l, L):
+                    for m in range(M):
+                        n = int(sizes[m])
+                        if n > d:
+                            continue
+                        c = C[l, j, m]
+                        if not np.isfinite(c) or c > t_max:
+                            continue
+                        if mem_budget > 0 and mem[l, j, m] > mem_budget:
+                            continue
+                        rest = f[j + 1][d - n]
+                        if rest == INF:
+                            continue
+                        if c + rest < f[l][d]:
+                            f[l][d] = c + rest
+                            cj[l][d] = j
+                            cm[l][d] = m
+        if f[0][D] == INF:
+            continue
+        obj = f[0][D] + (B - 1) * t_max
+        if obj < best_obj:
+            part = []
+            l, d = 0, D
+            ok = True
+            while l < L:
+                j, m = int(cj[l][d]), int(cm[l][d])
+                if j < 0:
+                    ok = False
+                    break
+                part.append((l, j + 1, m))
+                d -= int(sizes[m])
+                l = j + 1
+            if ok and d == 0:
+                best_obj, best_part = obj, part
+    return best_part
+
+
+########################################
+# orchestration: cost tensor + DP -> stage assignment
+########################################
 
 
 def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                   layer_comps, num_micro_batches, auto_sharding_option):
-    raise NotImplementedError(
-        "AutoStageOption (profile-and-DP stage construction) is not wired "
-        "yet; use UniformStageOption or ManualStageOption.")
+    """Fill the cost tensor with the static cost model and run the DP
+    (ref cluster_layers_and_slice_mesh auto branch, stage_construction.py:
+    571 + SURVEY.md §3.4)."""
+    from alpa_tpu.mesh_profiling import (estimate_stage_cost,
+                                         estimate_stage_memory)
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        get_sliced_virtual_submeshes, get_submesh_choices)
+
+    tic = time.time()
+    choices = get_submesh_choices(
+        virtual_mesh.num_hosts, virtual_mesh.num_devices_per_host,
+        getattr(stage_option, "submesh_physical_shape_space",
+                "power_of_two"))
+    sizes = [h * d for (h, d) in choices]
+    L, M = num_layers, len(choices)
+    D = virtual_mesh.num_devices
+
+    from alpa_tpu.device_mesh import LogicalDeviceMesh
+
+    if getattr(stage_option, "submesh_logical_shape_space",
+               "single_node_model_parallel") != "single_node_model_parallel":
+        logger.warning(
+            "submesh_logical_shape_space=%r: per-stage logical shapes are "
+            "searched by the intra-op planner, not here",
+            stage_option.submesh_logical_shape_space)
+
+    # Calibrate seconds/flop from a profiling DB if one is given
+    # (ref ProfilingResultDatabase path).
+    sec_per_flop = None
+    db_file = getattr(stage_option, "profiling_database_filename", None) or \
+        global_config.profiling_database_filename
+    if db_file:
+        try:
+            from alpa_tpu.mesh_profiling import ProfilingResultDatabase
+            db = ProfilingResultDatabase.load(db_file)
+            for res in db.data.values():
+                for key, points in res.dot_cost_dict.items():
+                    flop, sec = points[-1]
+                    sec_per_flop = sec / flop
+                    break
+                break
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning("loading profiling DB %s failed: %s", db_file, e)
+
+    use_ilp_cost = not getattr(stage_option, "use_hlo_cost_model", True) or \
+        (L * L * M <= 256)
+    mem_budget = float(
+        getattr(stage_option, "memory_budget_per_device", None) or 0.0)
+
+    costs = np.full((L, L, M), np.inf)
+    mem = np.zeros((L, L, M))
+    for m, (h, d) in enumerate(choices):
+        # cost-model-only logical mesh of the candidate submesh shape
+        shape = (h * d, 1) if h == 1 else (h, d)
+        logical = LogicalDeviceMesh(
+            None, np.arange(h * d).reshape(shape),
+            mesh_beta=(0.1 if h > 1 else 0.01, 0.01))
+        for i in range(L):
+            for j in range(i, L):
+                comps = layer_comps[i:j + 1]
+                kwargs = {"use_ilp": use_ilp_cost}
+                if sec_per_flop is not None:
+                    kwargs["sec_per_flop"] = sec_per_flop
+                costs[i, j, m] = estimate_stage_cost(
+                    comps, logical, auto_sharding_option, **kwargs)
+                if mem_budget > 0:
+                    mem[i, j, m] = estimate_stage_memory(
+                        comps, logical, num_in_flight=min(
+                            num_micro_batches, 4))
+
+    # stage_imbalance_tolerance: cap the DP's max-stage-cost threshold at
+    # tolerance * (best perfectly-balanced stage cost estimate).
+    tol = float(getattr(stage_option, "stage_imbalance_tolerance", np.inf))
+    if np.isfinite(tol):
+        finite = costs[np.isfinite(costs)]
+        if finite.size:
+            balanced = float(np.nanmin(
+                [costs[0, L - 1, m] for m in range(M)
+                 if np.isfinite(costs[0, L - 1, m])] or [np.inf]))
+            cap = tol * balanced / max(1, 1)
+            costs = np.where(costs <= cap, costs, np.inf)
+
+    part = stage_dp_solve(costs, sizes, D, num_micro_batches, mem,
+                          mem_budget=mem_budget)
+    if part is None:
+        raise RuntimeError(
+            "auto stage construction found no feasible partition")
+    logger.info("auto-stage DP: %d stages in %.2f s: %s",
+                len(part), time.time() - tic,
+                [(a, b, choices[m]) for a, b, m in part])
+
+    fwd_ids = [list(range(a, b)) for a, b, _m in part]
+    phys_shapes = [list(choices[m]) for _a, _b, m in part]
+    submeshes = get_sliced_virtual_submeshes(virtual_mesh, phys_shapes)
+    logical_shapes = [None] * len(part)
+    as_dicts = [{}] * len(part)
+    return fwd_ids, submeshes, logical_shapes, as_dicts

@@ -1,0 +1,219 @@
+"""Distributed checkpointing.
+
+Analog of ref ``alpa/serialization.py`` (SURVEY.md §5 checkpoint/resume):
+per-leaf directories containing per-shard files + an index, written by each
+host for its addressable shards in parallel, restored by reading only the
+slices each host needs.  Cross-topology restore (save on one mesh shape,
+load on another) is supported via slice assembly.
+
+Layout (flax-state-dict tree paths, ref tree-path directories):
+
+  ckpt_dir/
+    metadata.json                      # tree structure + leaf info
+    <leaf-path>/shard_<k>.npy          # one file per saved shard
+    <leaf-path>/index.json             # shard index -> global slice
+
+An optional node-local cache dir is drained to the shared FS by a
+background thread (ref DaemonMoveWorker, device_mesh.py:90).
+"""
+import json
+import logging
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+from flax.serialization import from_state_dict, to_state_dict
+from jax.tree_util import tree_flatten, tree_unflatten
+
+logger = logging.getLogger(__name__)
+
+_SEP = "."
+
+
+def _leaf_dirname(path_parts) -> str:
+    return _SEP.join(str(p) for p in path_parts) or "_root"
+
+
+def _flatten_state_dict(sd, prefix=()):
+    out = {}
+    if isinstance(sd, dict):
+        for k, v in sd.items():
+            out.update(_flatten_state_dict(v, prefix + (k,)))
+    else:
+        out[prefix] = sd
+    return out
+
+
+class _AsyncMover:
+    """Background mover from local cache to the final directory
+    (ref DaemonMoveWorker)."""
+
+    def __init__(self):
+        self.threads: List[threading.Thread] = []
+
+    def submit(self, src: str, dst: str):
+        t = threading.Thread(target=self._move, args=(src, dst), daemon=True)
+        t.start()
+        self.threads.append(t)
+
+    @staticmethod
+    def _move(src, dst):
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.move(src, dst)
+
+    def wait(self):
+        for t in self.threads:
+            t.join()
+        self.threads = []
+
+
+_mover = _AsyncMover()
+
+
+def save_checkpoint(ckpt_dir: str,
+                    target: Any,
+                    step: int,
+                    local_cache_dir: Optional[str] = None):
+    """Save a pytree of (possibly distributed) arrays (ref
+    serialization.py:75).
+
+    Every process writes the shards it can address; on a single-controller
+    runtime that is all of them.  ``local_cache_dir`` writes locally first
+    and drains asynchronously to ``ckpt_dir``.
+    """
+    sd = to_state_dict(target)
+    flat = _flatten_state_dict(sd)
+    write_dir = local_cache_dir or ckpt_dir
+    os.makedirs(write_dir, exist_ok=True)
+
+    metadata = {"step": step, "leaves": {}}
+    for path, leaf in flat.items():
+        name = _leaf_dirname(path)
+        leaf_dir = os.path.join(write_dir, name)
+        os.makedirs(leaf_dir, exist_ok=True)
+        index = []
+        if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
+            seen_slices = set()
+            k = 0
+            for shard in leaf.addressable_shards:
+                sl = tuple((s.start or 0,
+                            s.stop if s.stop is not None else dim)
+                           for s, dim in zip(shard.index, leaf.shape)) \
+                    if leaf.ndim else ()
+                if sl in seen_slices:
+                    continue  # replicated copy
+                seen_slices.add(sl)
+                np.save(os.path.join(leaf_dir, f"shard_{k}.npy"),
+                        np.asarray(shard.data))
+                index.append({"file": f"shard_{k}.npy",
+                              "slice": [list(x) for x in sl]})
+                k += 1
+            shape, dtype = list(leaf.shape), str(leaf.dtype)
+        else:
+            arr = np.asarray(leaf)
+            np.save(os.path.join(leaf_dir, "shard_0.npy"), arr)
+            index.append({"file": "shard_0.npy",
+                          "slice": [[0, d] for d in arr.shape]})
+            shape, dtype = list(arr.shape), str(arr.dtype)
+        with open(os.path.join(leaf_dir, "index.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(index, f)
+        metadata["leaves"][name] = {"shape": shape, "dtype": dtype}
+
+    with open(os.path.join(write_dir, "metadata.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(metadata, f)
+
+    if local_cache_dir:
+        os.makedirs(ckpt_dir, exist_ok=True)
+        for name in list(metadata["leaves"].keys()) + ["metadata.json"]:
+            _mover.submit(os.path.join(write_dir, name),
+                          os.path.join(ckpt_dir, name))
+
+
+def checkpoint_wait():
+    """Block until async cache drains finish."""
+    _mover.wait()
+
+
+def _load_leaf(leaf_dir: str, shape, dtype, sharding=None):
+    with open(os.path.join(leaf_dir, "index.json"), encoding="utf-8") as f:
+        index = json.load(f)
+    if sharding is None:
+        # assemble the full array
+        out = np.zeros(shape, dtype)
+        for ent in index:
+            arr = np.load(os.path.join(leaf_dir, ent["file"]))
+            sl = tuple(slice(a, b) for a, b in ent["slice"])
+            out[sl] = arr
+        return out
+    # sharded restore: build per-device slices from saved shards
+    full = None
+
+    def read_slice(global_slice):
+        nonlocal full
+        # exact-match fast path
+        for ent in index:
+            if tuple(tuple(x) for x in ent["slice"]) == global_slice:
+                return np.load(os.path.join(leaf_dir, ent["file"]))
+        if full is None:
+            full = _load_leaf(leaf_dir, shape, dtype, None)
+        return full[tuple(slice(a, b) for a, b in global_slice)]
+
+    ndim = len(shape)
+
+    def cb(idx):
+        sl = tuple((s.start or 0, s.stop if s.stop is not None else d)
+                   for s, d in zip(idx, shape)) if ndim else ()
+        return jax.numpy.asarray(read_slice(sl), dtype=dtype)
+
+    return jax.make_array_from_callback(
+        tuple(shape), sharding,
+        lambda idx: cb(idx))
+
+
+def restore_checkpoint(ckpt_dir: str,
+                       target: Any,
+                       shardings: Optional[Any] = None):
+    """Restore into the structure of ``target``
+    (ref serialization.py:137).  ``shardings``: optional pytree (matching
+    target) of NamedShardings; each host reads only its slices."""
+    with open(os.path.join(ckpt_dir, "metadata.json"),
+              encoding="utf-8") as f:
+        metadata = json.load(f)
+    sd = to_state_dict(target)
+    flat = _flatten_state_dict(sd)
+    shard_flat = {}
+    if shardings is not None:
+        shard_sd = to_state_dict(
+            jax.tree_util.tree_map(lambda x: x, shardings))
+        shard_flat = _flatten_state_dict(shard_sd)
+
+    new_flat = {}
+    for path in flat:
+        name = _leaf_dirname(path)
+        info = metadata["leaves"].get(name)
+        if info is None:
+            raise KeyError(f"checkpoint missing leaf {name}")
+        leaf_dir = os.path.join(ckpt_dir, name)
+        sharding = shard_flat.get(path)
+        new_flat[path] = _load_leaf(leaf_dir, tuple(info["shape"]),
+                                    np.dtype(info["dtype"]), sharding)
+
+    def rebuild(tree_path, sd_node):
+        if isinstance(sd_node, dict):
+            return {k: rebuild(tree_path + (k,), v)
+                    for k, v in sd_node.items()}
+        return new_flat[tree_path]
+
+    new_sd = rebuild((), sd)
+    return from_state_dict(target, new_sd)
+
+
+def load_checkpoint_metadata(ckpt_dir: str) -> Dict:
+    with open(os.path.join(ckpt_dir, "metadata.json"),
+              encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,9 @@
+"""Model serving: generation engine + HTTP controller.
+
+Analog of ref ``alpa/serve/`` + ``examples/llm_serving`` (SURVEY.md §2.8,
+§3.5): a controller with a model registry dispatching to replicas, and an
+autoregressive generation engine with resident KV caches compiled per
+(batch, length-bucket).
+"""
+from alpa_tpu.serve.generation import GenerationConfig, Generator, get_model
+from alpa_tpu.serve.controller import Controller, run_controller

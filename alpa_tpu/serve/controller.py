@@ -1,0 +1,153 @@
+"""HTTP serving controller.
+
+Analog of ref ``alpa/serve/controller.py:96`` (Controller Ray actor with
+uvicorn/starlette ingress + model registry + replica dispatch) — rebuilt on
+the standard library: a ``ThreadingHTTPServer`` front end, a registry of
+named models, round-robin replica dispatch, and per-model locks (device
+execution is serialized per replica; concurrent requests to different
+models overlap through jax's async dispatch).
+
+Endpoints:
+  GET  /models                          -> registered model names
+  POST /completions                     -> {"model", "prompt_ids",
+        "max_new_tokens"?, "temperature"?, "top_k"?, "do_sample"?}
+        => {"output_ids": [[...]]}
+  GET  /health                          -> liveness
+"""
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from alpa_tpu.serve.generation import GenerationConfig, Generator
+
+logger = logging.getLogger(__name__)
+
+
+class _Replica:
+
+    def __init__(self, generator: Generator):
+        self.generator = generator
+        self.lock = threading.Lock()
+
+
+class Controller:
+    """Model registry + dispatch (ref controller.py:96)."""
+
+    def __init__(self):
+        self._models: Dict[str, List[_Replica]] = {}
+        self._rr: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def register_model(self, name: str, generator: Generator):
+        with self._lock:
+            self._models.setdefault(name, []).append(_Replica(generator))
+            self._rr.setdefault(name, 0)
+        logger.info("registered model %s (%d replicas)", name,
+                    len(self._models[name]))
+
+    def list_models(self) -> List[str]:
+        return sorted(self._models)
+
+    def _pick_replica(self, name: str) -> _Replica:
+        with self._lock:
+            replicas = self._models[name]
+            i = self._rr[name] % len(replicas)
+            self._rr[name] += 1
+        return replicas[i]
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        name = request["model"]
+        if name not in self._models:
+            raise KeyError(f"unknown model {name!r}; "
+                           f"registered: {self.list_models()}")
+        prompt_ids = np.asarray(request["prompt_ids"], np.int32)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None]
+        cfg = GenerationConfig(
+            max_new_tokens=int(request.get("max_new_tokens", 32)),
+            temperature=float(request.get("temperature", 1.0)),
+            top_k=int(request.get("top_k", 0)),
+            do_sample=bool(request.get("do_sample", False)),
+            eos_token_id=request.get("eos_token_id"))
+        replica = self._pick_replica(name)
+        with replica.lock:
+            out = replica.generator.generate(prompt_ids, cfg)
+        return {"output_ids": out.tolist()}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    controller: Controller = None  # set by run_controller
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug(fmt, *args)
+
+    def _send(self, code: int, payload: Dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._send(200, {"status": "ok"})
+        elif self.path == "/models":
+            self._send(200, {"models": self.controller.list_models()})
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        if self.path != "/completions":
+            self._send(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            request = json.loads(self.rfile.read(length) or b"{}")
+            result = self.controller.completions(request)
+            self._send(200, result)
+        except KeyError as e:
+            self._send(404, {"error": str(e)})
+        except (json.JSONDecodeError, ValueError, AssertionError,
+                TypeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception("completions failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class ControllerServer:
+    """The running HTTP server (ref run_controller:280)."""
+
+    def __init__(self, controller: Controller, host: str, port: int):
+        handler = type("BoundHandler", (_Handler,),
+                       {"controller": controller})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.controller = controller
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self.thread.start()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def run_controller(host: str = "127.0.0.1",
+                   port: int = 8000,
+                   start: bool = True) -> ControllerServer:
+    """Create (and start) a controller server (ref run_controller:280)."""
+    server = ControllerServer(Controller(), host, port)
+    if start:
+        server.start()
+    return server

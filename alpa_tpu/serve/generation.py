@@ -1,0 +1,125 @@
+"""Autoregressive generation with resident KV caches.
+
+Analog of ref ``examples/llm_serving/model/wrapper.py:501`` (``get_model``,
+the HF-GenerationMixin-compatible wrapper): prefill + decode executables
+compiled once, KV caches living on device between steps (ref
+``init_cache_dis_array`` opt_model.py:1044 — here plain sharded jax.Arrays
+threaded through the jitted step, ref cache-as-invars design).
+
+Supports greedy / temperature / top-k sampling, batched requests, and a
+pluggable parallel method (ShardParallel on one mesh today; the pipeshard
+inference schedule slots in via the same executable interface).
+"""
+import dataclasses
+import logging
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alpa_tpu.model.gpt_model import GPTConfig, GPTModel, init_kv_caches
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class GenerationConfig:
+    max_new_tokens: int = 32
+    temperature: float = 1.0
+    top_k: int = 0           # 0 = no top-k filtering
+    do_sample: bool = False
+    eos_token_id: Optional[int] = None
+
+
+def _sample_logits(logits, rng, cfg: GenerationConfig):
+    logits = logits.astype(jnp.float32)
+    if not cfg.do_sample:
+        return jnp.argmax(logits, axis=-1)
+    if cfg.temperature != 1.0:
+        logits = logits / jnp.maximum(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        top = jax.lax.top_k(logits, cfg.top_k)[0][..., -1:]
+        logits = jnp.where(logits < top, -1e9, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+class Generator:
+    """Compiled prefill + decode loop over a GPT-family model."""
+
+    def __init__(self, model: GPTModel, params, config: GPTConfig,
+                 batch_size: int = 1):
+        self.model = model
+        self.params = params
+        self.config = config
+        self.batch_size = batch_size
+
+        def prefill(params, input_ids, caches):
+            b, s = input_ids.shape
+            pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+            logits, caches = model.apply(params, input_ids, pos, caches)
+            return logits[:, -1, :], caches
+
+        def decode(params, token, index, caches):
+            b = token.shape[0]
+            pos = jnp.full((b, 1), index, jnp.int32)
+            logits, caches = model.apply(params, token, pos, caches)
+            return logits[:, 0, :], caches
+
+        self._prefill = jax.jit(prefill)
+        self._decode = jax.jit(decode)
+
+    def generate(self,
+                 input_ids: np.ndarray,
+                 generation_config: Optional[GenerationConfig] = None,
+                 rng: Optional[jax.Array] = None) -> np.ndarray:
+        """input_ids: (B, S_prompt) -> (B, S_prompt + max_new_tokens)."""
+        cfg = generation_config or GenerationConfig()
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        b, s = input_ids.shape
+        assert s + cfg.max_new_tokens <= self.config.seq_len, (
+            f"prompt {s} + max_new_tokens {cfg.max_new_tokens} exceeds "
+            f"seq_len {self.config.seq_len}")
+
+        caches = init_kv_caches(self.config, b)
+        logits, caches = self._prefill(self.params, input_ids, caches)
+        tokens = [input_ids]
+        finished = jnp.zeros((b,), bool)
+        index = s
+        for i in range(cfg.max_new_tokens):
+            rng, sub = jax.random.split(rng)
+            nxt = _sample_logits(logits, sub, cfg).astype(jnp.int32)
+            if cfg.eos_token_id is not None:
+                nxt = jnp.where(finished, cfg.eos_token_id, nxt)
+                finished = finished | (nxt == cfg.eos_token_id)
+            tokens.append(nxt[:, None])
+            logits, caches = self._decode(self.params, nxt[:, None], index,
+                                          caches)
+            index += 1
+            if cfg.eos_token_id is not None and bool(finished.all()):
+                break
+        return np.asarray(jnp.concatenate(tokens, axis=1))
+
+
+def get_model(name_or_config,
+              params=None,
+              batch_size: int = 1,
+              rngkey=None) -> Generator:
+    """Build a servable Generator (ref wrapper.py:501 get_model).
+
+    ``name_or_config``: a GPTConfig, or a ladder name like "gpt-125M"
+    (random-initialized — weight loading plugs in via ``params``).
+    """
+    from alpa_tpu.model.gpt_model import config_from_spec, init_gpt_real
+
+    if isinstance(name_or_config, GPTConfig):
+        config = name_or_config
+    else:
+        spec = str(name_or_config).split("-")[-1]
+        config = config_from_spec(spec)
+    model = GPTModel(config)
+    if params is None:
+        model, params = init_gpt_real(config, batch_size, rngkey)
+    return Generator(model, params, config, batch_size)

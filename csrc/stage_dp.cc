@@ -1,0 +1,142 @@
+// OSDI'22 inter-op stage construction dynamic program, native implementation.
+//
+// Re-derivation of the algorithm driven by the reference's
+// alpa/pipeline_parallel/stage_construction.py:235 (training_dp_impl, there
+// numba-jit Python); this framework ships it as C++ (the reference keeps its
+// heavy passes native too, SURVEY.md §2.9).
+//
+// Problem: split L contiguous layers into stages; give stage t a submesh
+// from the choice list (n_m devices each) so submesh sizes sum to exactly D;
+// minimize  sum_t cost_t + (B - 1) * max_t cost_t
+// where cost_t = C[i][j][m] for layers i..j on submesh m and B = number of
+// microbatches.  Solved by iterating candidate values of max_t cost_t
+// (t_max) and, for each, a DP over (first uncovered layer, devices left)
+// minimizing the total sum subject to every stage cost <= t_max.
+//
+// Exported C ABI (ctypes):
+//   int stage_dp_solve(L, M, D, B, C[L*L*M], n_devices[M], mem[L*L*M],
+//                      mem_budget, out_starts[L], out_meshes[L]) ->
+//   number of stages (or -1 if infeasible). Stage t covers layers
+//   out_starts[t] .. out_starts[t+1]-1 on submesh out_meshes[t].
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+
+constexpr double kInf = std::numeric_limits<double>::infinity();
+
+struct DPResult {
+  double total;
+  std::vector<int> starts;
+  std::vector<int> meshes;
+};
+
+// DP for a fixed t_max: f[l][d] = min total cost covering layers l..L-1
+// with exactly d devices left. Returns total and the partition.
+bool run_dp(int L, int M, int D, const double* C, const int64_t* ndev,
+            const double* mem, double mem_budget, double t_max,
+            DPResult* out) {
+  const int stride_j = M;
+  const int stride_i = L * M;
+  std::vector<double> f((L + 1) * (D + 1), kInf);
+  // choice: encodes (j, m) for backtracking
+  std::vector<int32_t> choice_j((L + 1) * (D + 1), -1);
+  std::vector<int32_t> choice_m((L + 1) * (D + 1), -1);
+  auto idx = [D](int l, int d) { return l * (D + 1) + d; };
+  f[idx(L, 0)] = 0.0;
+
+  for (int l = L - 1; l >= 0; --l) {
+    for (int d = 1; d <= D; ++d) {
+      double best = kInf;
+      int bj = -1, bm = -1;
+      for (int j = l; j < L; ++j) {
+        const double* row = C + l * stride_i + j * stride_j;
+        const double* mrow = mem + l * stride_i + j * stride_j;
+        for (int m = 0; m < M; ++m) {
+          const int64_t n = ndev[m];
+          if (n > d) continue;
+          const double c = row[m];
+          if (c > t_max || c >= kInf) continue;
+          if (mem_budget > 0 && mrow[m] > mem_budget) continue;
+          const double rest = f[idx(j + 1, d - static_cast<int>(n))];
+          if (rest >= kInf) continue;
+          const double tot = c + rest;
+          if (tot < best) {
+            best = tot;
+            bj = j;
+            bm = m;
+          }
+        }
+      }
+      f[idx(l, d)] = best;
+      choice_j[idx(l, d)] = bj;
+      choice_m[idx(l, d)] = bm;
+    }
+  }
+  if (f[idx(0, D)] >= kInf) return false;
+
+  out->total = f[idx(0, D)];
+  out->starts.clear();
+  out->meshes.clear();
+  int l = 0, d = D;
+  while (l < L) {
+    const int j = choice_j[idx(l, d)];
+    const int m = choice_m[idx(l, d)];
+    if (j < 0 || m < 0) return false;
+    out->starts.push_back(l);
+    out->meshes.push_back(m);
+    d -= static_cast<int>(ndev[m]);
+    l = j + 1;
+  }
+  return d == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int stage_dp_solve(int32_t L, int32_t M, int32_t D, int32_t B,
+                   const double* C, const int64_t* n_devices,
+                   const double* mem, double mem_budget,
+                   int32_t* out_starts, int32_t* out_meshes) {
+  if (L <= 0 || M <= 0 || D <= 0) return -1;
+  // Candidate t_max values: every distinct finite stage cost.
+  std::vector<double> candidates;
+  candidates.reserve(static_cast<size_t>(L) * L * M);
+  for (int i = 0; i < L; ++i)
+    for (int j = i; j < L; ++j)
+      for (int m = 0; m < M; ++m) {
+        const double c = C[(i * L + j) * M + m];
+        if (c < kInf) candidates.push_back(c);
+      }
+  if (candidates.empty()) return -1;
+  std::sort(candidates.begin(), candidates.end());
+  candidates.erase(std::unique(candidates.begin(), candidates.end()),
+                   candidates.end());
+
+  double best_obj = kInf;
+  DPResult best;
+  DPResult cur;
+  for (double t_max : candidates) {
+    if (best_obj < kInf && (B - 1) * t_max >= best_obj) break;
+    if (!run_dp(L, M, D, C, n_devices, mem, mem_budget, t_max, &cur))
+      continue;
+    const double obj = cur.total + (B - 1) * t_max;
+    if (obj < best_obj) {
+      best_obj = obj;
+      best = cur;
+    }
+  }
+  if (best_obj >= kInf) return -1;
+  const int S = static_cast<int>(best.starts.size());
+  for (int t = 0; t < S; ++t) {
+    out_starts[t] = best.starts[t];
+    out_meshes[t] = best.meshes[t];
+  }
+  return S;
+}
+
+}  // extern "C"

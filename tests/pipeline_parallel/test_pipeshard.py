@@ -235,3 +235,27 @@ class TestPipeshardInference:
 
         with pytest.raises(ValueError, match="scalar output"):
             mean_out(state, batch)
+
+
+class TestAutoStage:
+
+    def test_auto_stage_construction(self):
+        """OSDI'22-style auto path: auto layers -> cost model -> native DP
+        -> heterogeneous submeshes -> pipeshard runtime == serial."""
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            AutoStageOption)
+        ex = _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=4,
+                              layer_option=AutoLayerOption(layer_num=4),
+                              stage_option=AutoStageOption(),
+                              pipeline_schedule="1f1b"),
+            num_layers=8, manual=False)
+        assert ex.num_meshes >= 1
+
+    def test_native_dp_solver_loaded(self):
+        import shutil
+        if shutil.which("make") is None or shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain; Python fallback covers this env")
+        from alpa_tpu.pipeline_parallel.stage_dp import _load_native
+        assert _load_native() is not None, (
+            "C++ stage DP library failed to build/load")
