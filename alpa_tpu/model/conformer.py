@@ -1,0 +1,84 @@
+"""Conformer encoder (speech) for the model zoo.
+
+Analog of ref ``alpa/model/conformer.py`` (314 LoC): conformer blocks =
+half-step FFN, multi-head self-attention with relative-ish positions,
+depthwise conv module, half-step FFN, all pre-norm with residuals.
+"""
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ConformerConfig:
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    conv_kernel_size: int = 15
+    ffn_ratio: int = 4
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.0
+
+
+class FeedForwardModule(nn.Module):
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(cfg.ffn_ratio * cfg.hidden_size, dtype=cfg.dtype)(h)
+        h = nn.swish(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(h)
+        return h
+
+
+class ConvModule(nn.Module):
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(2 * cfg.hidden_size, dtype=cfg.dtype)(h)
+        h = nn.glu(h, axis=-1)
+        # depthwise conv over time
+        h = nn.Conv(cfg.hidden_size, (cfg.conv_kernel_size,),
+                    feature_group_count=cfg.hidden_size,
+                    dtype=cfg.dtype)(h)
+        h = nn.GroupNorm(num_groups=1, dtype=jnp.float32)(h)
+        h = nn.swish(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(h)
+        return h
+
+
+class ConformerBlock(nn.Module):
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = x + 0.5 * FeedForwardModule(cfg, name="ffn1")(x)
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.MultiHeadDotProductAttention(num_heads=cfg.num_heads,
+                                            dtype=cfg.dtype)(h, h)
+        x = x + h
+        x = x + ConvModule(cfg, name="conv")(x)
+        x = x + 0.5 * FeedForwardModule(cfg, name="ffn2")(x)
+        return nn.LayerNorm(dtype=jnp.float32)(x)
+
+
+class Conformer(nn.Module):
+    """Encoder: (B, T, F) features -> (B, T, H) representations."""
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj_in")(x)
+        for i in range(cfg.num_layers):
+            x = ConformerBlock(cfg, name=f"block_{i}")(x)
+        return x

@@ -1,0 +1,125 @@
+"""2D UNet (diffusion-style) for the vision benchmark suite.
+
+Analog of ref ``alpa/model/unet_2d.py`` (1207 LoC diffusers-style UNet used
+by ``benchmark/alpa/suite_unet.py``): timestep-conditioned down/mid/up
+blocks with attention at low resolutions and skip connections.  Written
+compactly and TPU-first (GroupNorm in fp32, channels-last convs).
+"""
+import dataclasses
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 3
+    out_channels: int = 3
+    block_channels: Tuple[int, ...] = (64, 128, 256)
+    layers_per_block: int = 2
+    attention_resolutions: Tuple[int, ...] = (2,)  # block indices w/ attn
+    num_heads: int = 4
+    time_embed_dim: int = 256
+    dtype: Any = jnp.float32
+
+
+def _num_groups(channels: int, max_groups: int = 32) -> int:
+    """Largest divisor of ``channels`` not exceeding ``max_groups``."""
+    g = min(max_groups, channels)
+    while channels % g != 0:
+        g -= 1
+    return g
+
+
+def timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = jnp.exp(-np.log(10000.0) *
+                    jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None]
+    return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+
+class ResBlock(nn.Module):
+    channels: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, temb):
+        h = nn.GroupNorm(num_groups=_num_groups(x.shape[-1]),
+                         dtype=jnp.float32)(x)
+        h = nn.swish(h)
+        h = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(h)
+        h = h + nn.Dense(self.channels, dtype=self.dtype)(
+            nn.swish(temb))[:, None, None, :]
+        h = nn.GroupNorm(num_groups=_num_groups(self.channels),
+                         dtype=jnp.float32)(h)
+        h = nn.swish(h)
+        h = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(h)
+        if x.shape[-1] != self.channels:
+            x = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+        return x + h
+
+
+class AttnBlock2D(nn.Module):
+    num_heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):
+        b, h, w, c = x.shape
+        y = nn.GroupNorm(num_groups=_num_groups(c), dtype=jnp.float32)(x)
+        y = y.reshape(b, h * w, c)
+        y = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads, dtype=self.dtype)(y, y)
+        return x + y.reshape(b, h, w, c)
+
+
+class UNet2D(nn.Module):
+    config: UNetConfig
+
+    @nn.compact
+    def __call__(self, x, timesteps):
+        cfg = self.config
+        temb = timestep_embedding(timesteps, cfg.time_embed_dim)
+        temb = nn.Dense(cfg.time_embed_dim, dtype=cfg.dtype)(temb)
+        temb = nn.Dense(cfg.time_embed_dim, dtype=cfg.dtype)(
+            nn.swish(temb))
+
+        h = nn.Conv(cfg.block_channels[0], (3, 3), dtype=cfg.dtype,
+                    name="conv_in")(x)
+        skips = [h]
+        # down
+        for bi, ch in enumerate(cfg.block_channels):
+            for _ in range(cfg.layers_per_block):
+                h = ResBlock(ch, cfg.dtype)(h, temb)
+                if bi in cfg.attention_resolutions:
+                    h = AttnBlock2D(cfg.num_heads, cfg.dtype)(h)
+                skips.append(h)
+            if bi < len(cfg.block_channels) - 1:
+                h = nn.Conv(ch, (3, 3), (2, 2), dtype=cfg.dtype)(h)
+                skips.append(h)
+        # mid
+        mid_ch = cfg.block_channels[-1]
+        h = ResBlock(mid_ch, cfg.dtype)(h, temb)
+        h = AttnBlock2D(cfg.num_heads, cfg.dtype)(h)
+        h = ResBlock(mid_ch, cfg.dtype)(h, temb)
+        # up
+        for bi, ch in reversed(list(enumerate(cfg.block_channels))):
+            for _ in range(cfg.layers_per_block + 1):
+                h = jnp.concatenate([h, skips.pop()], axis=-1)
+                h = ResBlock(ch, cfg.dtype)(h, temb)
+                if bi in cfg.attention_resolutions:
+                    h = AttnBlock2D(cfg.num_heads, cfg.dtype)(h)
+            if bi > 0:
+                b, hh, ww, c = h.shape
+                h = jax.image.resize(h, (b, hh * 2, ww * 2, c), "nearest")
+                h = nn.Conv(c, (3, 3), dtype=cfg.dtype)(h)
+        h = nn.GroupNorm(num_groups=_num_groups(h.shape[-1]),
+                         dtype=jnp.float32)(h)
+        h = nn.swish(h)
+        return nn.Conv(cfg.out_channels, (3, 3), dtype=cfg.dtype,
+                       name="conv_out")(h)

@@ -236,6 +236,7 @@ class PipeshardDriverExecutable:
         return order
 
     def _emit(self):
+        self._resharding_bytes = 0.0
         ginvar_idx = {v: i for i, v in enumerate(self.global_invars)}
         batch_var = {
             v for v, b in zip(self.global_invars, self.batch_invars) if b
@@ -296,12 +297,25 @@ class PipeshardDriverExecutable:
                 location[key] = OrderedSet([m for m, _ in place_list])
             if mesh_id not in location[key]:
                 src = next(iter(location[key]))
-                instructions.append(
-                    PipelineInstruction(PipelineInstType.RESHARD,
-                                        var_key=key, src_mesh=src,
-                                        dst_mesh=mesh_id,
-                                        dst_sharding=dst_sharding,
-                                        info=exec_name))
+                inst = PipelineInstruction(PipelineInstType.RESHARD,
+                                           var_key=key, src_mesh=src,
+                                           dst_mesh=mesh_id,
+                                           dst_sharding=dst_sharding,
+                                           info=exec_name)
+                # plan the cross-mesh transfer (tile coverage + local
+                # allgather rewrite) for accounting/reporting
+                src_sh = sharding_at.get((v, key[1], src))
+                if src_sh is not None and hasattr(v.aval, "shape"):
+                    try:
+                        from alpa_tpu.pipeline_parallel. \
+                            cross_mesh_resharding import plan_resharding
+                        inst.plan = plan_resharding(
+                            tuple(v.aval.shape), v.aval.dtype.itemsize,
+                            src_sh, dst_sharding)
+                        self._resharding_bytes += inst.plan.transfer_bytes
+                    except Exception:  # pylint: disable=broad-except
+                        inst.plan = None
+                instructions.append(inst)
                 location[key].add(mesh_id)
                 sharding_at[(v, key[1], mesh_id)] = dst_sharding
                 return
@@ -551,6 +565,15 @@ class PipeshardDriverExecutable:
 
     def get_instruction_text(self) -> str:
         return "\n".join(repr(i) for i in self.instructions)
+
+    def get_resharding_report(self) -> str:
+        """Planned cross-mesh traffic per step (tile-level accounting from
+        cross_mesh_resharding.plan_resharding)."""
+        n = sum(1 for i in self.instructions
+                if i.opcode == PipelineInstType.RESHARD and
+                i.src_mesh != i.dst_mesh)
+        return (f"{n} cross-mesh transfers, "
+                f"{self._resharding_bytes / 1e6:.3f} MB per step (planned)")
 
     def sync(self):
         self.mesh_group.sync_workers()

@@ -1,0 +1,25 @@
+"""PyTorch frontend: trace torch modules into jax functions.
+
+Analog of ref ``alpa/torch/`` (SURVEY.md §2.8: fx-traces PyTorch to jax;
+``set_mode("local"/"dist")`` ref torch/__init__.py:33).  A ``torch.fx``
+symbolic trace is converted node-by-node into a pure jax function over a
+params pytree (the module's state_dict), which then goes through
+``@alpa_tpu.parallelize`` like any jax function.
+"""
+from alpa_tpu.torch_frontend.converter import (functionalize, fx_to_jax,
+                                               torch_to_jax_array)
+
+_mode = "local"
+
+
+def set_mode(mode: str):
+    """"local" = run converted functions on one device for debugging;
+    "dist" = hand them to alpa_tpu.parallelize (ref torch/__init__.py:33).
+    """
+    global _mode
+    assert mode in ("local", "dist")
+    _mode = mode
+
+
+def get_mode() -> str:
+    return _mode

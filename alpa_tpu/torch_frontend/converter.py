@@ -1,0 +1,364 @@
+"""torch.fx graph -> jax function conversion.
+
+Analog of ref ``alpa/torch/ops/mapping.py`` + ``alpa/torch/nn/``: an op
+mapping table from torch functions/modules to jax equivalents, driven over
+an ``fx.GraphModule``.  Parameters/buffers become a flat dict pytree keyed
+by their state_dict names; the returned function is pure:
+
+  fn(params: dict[str, jax.Array], *inputs) -> outputs
+
+Coverage targets the reference's functionalized nn surface: Linear, conv,
+norms (eval), embeddings, activations, elementwise/matmul/reshape ops,
+dropout (eval = identity).
+"""
+import logging
+import operator
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def torch_to_jax_array(t):
+    return jnp.asarray(t.detach().cpu().numpy())
+
+
+########################################
+# op mappings
+########################################
+
+
+def _linear(x, w, b=None):
+    y = x @ w.T
+    return y + b if b is not None else y
+
+
+def _layer_norm(x, shape, w, b, eps):
+    axes = tuple(range(x.ndim - len(shape), x.ndim))
+    mean = x.mean(axes, keepdims=True)
+    var = ((x - mean)**2).mean(axes, keepdims=True)
+    y = (x - mean) / jnp.sqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+def _conv2d(x, w, b, stride, padding, dilation, groups):
+    # torch NCHW / OIHW
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    pad = [(padding[0], padding[0]), (padding[1], padding[1])]
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def _embedding(ids, weight):
+    return weight[ids]
+
+
+def _flatten(x, start_dim=0, end_dim=-1):
+    if end_dim < 0:
+        end_dim += x.ndim
+    return jnp.reshape(
+        x, x.shape[:start_dim] + (-1,) + x.shape[end_dim + 1:])
+
+
+def _adaptive_avg_pool2d(x, out):
+    out = tuple(np.ravel(out))
+    if out in ((1,), (1, 1)):
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    oh, ow = (out[0], out[0]) if len(out) == 1 else out
+    h, w = x.shape[2], x.shape[3]
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(x.shape[0], x.shape[1], oh, h // oh, ow,
+                         w // ow).mean(axis=(3, 5))
+    raise NotImplementedError(
+        f"adaptive_avg_pool2d to {out} from {(h, w)} (non-divisible) has "
+        "no jax mapping yet")
+
+def _softmax(x, dim=-1, **_):
+    return jax.nn.softmax(x, axis=dim)
+
+
+def _mean(x, dim=None, keepdim=False, **_):
+    return jnp.mean(x, axis=dim, keepdims=keepdim)
+
+
+def _sum(x, dim=None, keepdim=False, **_):
+    return jnp.sum(x, axis=dim, keepdims=keepdim)
+
+
+def _permute(x, *dims):
+    if len(dims) == 1 and isinstance(dims[0], (tuple, list)):
+        dims = tuple(dims[0])
+    return jnp.transpose(x, dims)
+
+
+def _view(x, *shape):
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return jnp.reshape(x, shape)
+
+
+def _transpose2(x, d0, d1):
+    perm = list(range(x.ndim))
+    perm[d0], perm[d1] = perm[d1], perm[d0]
+    return jnp.transpose(x, perm)
+
+
+def _contiguous(x):
+    return x
+
+
+def _max_pool2d(x, kernel_size, stride=None, padding=0, **_):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, 1) + tuple(kernel_size), (1, 1) + tuple(stride),
+        [(0, 0), (0, 0), (padding[0], padding[0]),
+         (padding[1], padding[1])])
+
+
+# name -> callable; covers torch.nn.functional + tensor methods + operators
+FUNCTION_MAP: Dict[str, Callable] = {
+    "linear": _linear,
+    "relu": jax.nn.relu,
+    "gelu": lambda x, approximate="none": jax.nn.gelu(
+        x, approximate=(approximate == "tanh")),
+    "silu": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": _softmax,
+    "log_softmax": lambda x, dim=-1, **_: jax.nn.log_softmax(x, axis=dim),
+    "dropout": lambda x, p=0.5, training=False, inplace=False: x,
+    "layer_norm": _layer_norm,
+    "embedding": _embedding,
+    "conv2d": _conv2d,
+    "max_pool2d": _max_pool2d,
+    "adaptive_avg_pool2d": lambda x, out: _adaptive_avg_pool2d(x, out),
+    "matmul": jnp.matmul,
+    "bmm": jnp.matmul,
+    "add": operator.add,
+    "sub": operator.sub,
+    "mul": operator.mul,
+    "truediv": operator.truediv,
+    "div": jnp.divide,
+    "neg": operator.neg,
+    "pow": operator.pow,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "mean": _mean,
+    "sum": _sum,
+    "max": lambda x, *a, **k: jnp.max(x, *a, **k),
+    "min": lambda x, *a, **k: jnp.min(x, *a, **k),
+    "cat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+    "stack": lambda ts, dim=0: jnp.stack(ts, axis=dim),
+    "split": lambda x, n, dim=0: jnp.split(
+        x, range(n, x.shape[dim], n), axis=dim),
+    "chunk": lambda x, n, dim=0: jnp.split(x, n, axis=dim),
+    "flatten": lambda x, start_dim=0, end_dim=-1: _flatten(
+        x, start_dim, end_dim),
+    "view": _view,
+    "reshape": _view,
+    "permute": _permute,
+    "transpose": _transpose2,
+    "contiguous": _contiguous,
+    "expand": lambda x, *s: jnp.broadcast_to(
+        x, tuple(xs if ss == -1 else ss for ss, xs in
+                 zip(s, x.shape)) if len(s) == x.ndim else s),
+    "unsqueeze": lambda x, dim: jnp.expand_dims(x, dim),
+    "squeeze": lambda x, dim=None: jnp.squeeze(x, dim),
+    "masked_fill": lambda x, mask, val: jnp.where(mask, val, x),
+    "getitem": operator.getitem,
+    "getattr": getattr,
+    "float": lambda x: x.astype(jnp.float32),
+    "size": lambda x, d=None: x.shape if d is None else x.shape[d],
+    "to": lambda x, *a, **k: x,
+    "type_as": lambda x, y: x.astype(y.dtype),
+    "clone": lambda x: x,
+    "detach": lambda x: jax.lax.stop_gradient(x),
+}
+
+
+########################################
+# module-level mappings (call_module nodes)
+########################################
+
+
+def _convert_module(mod, params_prefix: str):
+    """Return fn(params, *args) for a leaf torch module."""
+    import torch
+
+    if isinstance(mod, torch.nn.Linear):
+        def f(p, x):
+            return _linear(x, p[f"{params_prefix}weight"],
+                           p.get(f"{params_prefix}bias"))
+        return f
+    if isinstance(mod, torch.nn.Embedding):
+        return lambda p, ids: _embedding(ids, p[f"{params_prefix}weight"])
+    if isinstance(mod, torch.nn.LayerNorm):
+        shape = tuple(mod.normalized_shape)
+        eps = mod.eps
+        def f(p, x):
+            return _layer_norm(x, shape, p.get(f"{params_prefix}weight"),
+                               p.get(f"{params_prefix}bias"), eps)
+        return f
+    if isinstance(mod, torch.nn.Conv2d):
+        stride, padding = mod.stride, mod.padding
+        dilation, groups = mod.dilation, mod.groups
+        def f(p, x):
+            return _conv2d(x, p[f"{params_prefix}weight"],
+                           p.get(f"{params_prefix}bias"), stride, padding,
+                           dilation, groups)
+        return f
+    if isinstance(mod, (torch.nn.ReLU,)):
+        return lambda p, x: jax.nn.relu(x)
+    if isinstance(mod, (torch.nn.GELU,)):
+        approx = getattr(mod, "approximate", "none") == "tanh"
+        return lambda p, x: jax.nn.gelu(x, approximate=approx)
+    if isinstance(mod, (torch.nn.SiLU,)):
+        return lambda p, x: jax.nn.silu(x)
+    if isinstance(mod, (torch.nn.Tanh,)):
+        return lambda p, x: jnp.tanh(x)
+    if isinstance(mod, (torch.nn.Sigmoid,)):
+        return lambda p, x: jax.nn.sigmoid(x)
+    if isinstance(mod, (torch.nn.Dropout,)):
+        return lambda p, x: x  # eval mode
+    if isinstance(mod, (torch.nn.Softmax,)):
+        dim = mod.dim if mod.dim is not None else -1
+        return lambda p, x: jax.nn.softmax(x, axis=dim)
+    if isinstance(mod, (torch.nn.Flatten,)):
+        sd, ed = mod.start_dim, mod.end_dim
+        return lambda p, x: _flatten(x, sd, ed)
+    if isinstance(mod, torch.nn.MaxPool2d):
+        ks, st, pd = mod.kernel_size, mod.stride, mod.padding
+        return lambda p, x: _max_pool2d(x, ks, st, pd)
+    if isinstance(mod, torch.nn.BatchNorm2d):
+        eps = mod.eps
+        def f(p, x):
+            mean = p[f"{params_prefix}running_mean"]
+            var = p[f"{params_prefix}running_var"]
+            w = p.get(f"{params_prefix}weight")
+            b = p.get(f"{params_prefix}bias")
+            y = (x - mean[None, :, None, None]) / jnp.sqrt(
+                var[None, :, None, None] + eps)
+            if w is not None:
+                y = y * w[None, :, None, None]
+            if b is not None:
+                y = y + b[None, :, None, None]
+            return y
+        return f
+    raise NotImplementedError(
+        f"torch module {type(mod).__name__} has no jax mapping yet")
+
+
+########################################
+# graph conversion
+########################################
+
+
+def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
+    """Convert an fx.GraphModule into fn(params, *inputs).
+
+    ``params`` is used to validate at conversion time that every
+    ``get_attr`` target has a backing entry, so missing-parameter errors
+    surface here rather than on first call."""
+    import torch
+
+    modules = dict(gm.named_modules())
+    missing = [n.target for n in gm.graph.nodes
+               if n.op == "get_attr" and n.target not in params]
+    if missing:
+        raise KeyError(f"params dict missing fx get_attr targets: "
+                       f"{missing}")
+
+    def fn(p, *inputs):
+        env: Dict[str, Any] = {}
+        input_iter = iter(inputs)
+
+        def lookup(a):
+            import torch as _t
+            if isinstance(a, torch.fx.Node):
+                return env[a.name]
+            if isinstance(a, (list, tuple)):
+                return type(a)(lookup(x) for x in a)
+            if isinstance(a, _t.Tensor):
+                return torch_to_jax_array(a)
+            return a
+
+        out = None
+        for node in gm.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = next(input_iter)
+            elif node.op == "get_attr":
+                key = node.target
+                env[node.name] = p[key]
+            elif node.op == "call_function":
+                fname = getattr(node.target, "__name__", str(node.target))
+                f = FUNCTION_MAP.get(fname)
+                if f is None:
+                    raise NotImplementedError(
+                        f"torch function {fname} has no jax mapping yet")
+                args = [lookup(a) for a in node.args]
+                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                env[node.name] = f(*args, **kwargs)
+            elif node.op == "call_method":
+                f = FUNCTION_MAP.get(node.target)
+                if f is None:
+                    raise NotImplementedError(
+                        f"tensor method {node.target} has no jax mapping")
+                args = [lookup(a) for a in node.args]
+                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                env[node.name] = f(*args, **kwargs)
+            elif node.op == "call_module":
+                mod = modules[node.target]
+                mf = _convert_module(mod, node.target + ".")
+                args = [lookup(a) for a in node.args]
+                env[node.name] = mf(p, *args)
+            elif node.op == "output":
+                out = lookup(node.args[0])
+        return out
+
+    return fn
+
+
+def functionalize(module, concrete_args=None):
+    """torch.nn.Module -> (jax_fn, params_dict).
+
+    jax_fn(params, *jax_inputs) reproduces module.forward in eval mode
+    (ref: the functionalized nn of alpa/torch/nn/).
+    """
+    import torch
+    import torch.fx
+
+    module = module.eval()
+    gm = torch.fx.symbolic_trace(module, concrete_args=concrete_args)
+    params = {
+        k: torch_to_jax_array(v)
+        for k, v in {**dict(module.state_dict())}.items()
+    }
+    fn = fx_to_jax(gm, params)
+    return fn, params

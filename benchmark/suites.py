@@ -1,0 +1,72 @@
+"""Benchmark case definitions.
+
+Analog of ref ``benchmark/alpa/suite_manual_gpt.py`` /
+``suite_auto_gpt.py`` / ``suite_auto_moe.py`` / ``suite_wresnet.py``:
+named suites of benchmark cases.  Model ladders match the reference specs
+(GPT 125M..76B at seq 1024, vocab 51200, ref suite_manual_gpt.py:18-26).
+"""
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class BenchmarkCase:
+    name: str
+    family: str               # "gpt" | "moe" | "wresnet"
+    model: Dict[str, Any]
+    batch_size: int
+    num_micro_batches: int = 1
+    # parallel method: "shard" | "pipeshard" | "dp" | "zero3"
+    method: str = "shard"
+    method_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    dtype: str = "bfloat16"
+
+
+# ---- GPT ladder (ref suite_manual_gpt.py:18-26) ----
+GPT_SPECS = {
+    "125M": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "350M": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "760M": dict(hidden_size=1536, num_layers=24, num_heads=16),
+    "1.3B": dict(hidden_size=2048, num_layers=24, num_heads=32),
+    "2.6B": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    "6.7B": dict(hidden_size=4096, num_layers=32, num_heads=32),
+}
+
+
+def _gpt(name, spec_name, bs, nmb=1, method="shard", seq=1024,
+         attention_impl="reference", **mk):
+    spec = dict(GPT_SPECS[spec_name])
+    spec.update(seq_len=seq, vocab_size=51200,
+                attention_impl=attention_impl)
+    return BenchmarkCase(name, "gpt", spec, bs, nmb, method, mk)
+
+
+suites = {
+    # quick single-chip perf check (the bench.py default case)
+    "gpt.tiny": [
+        _gpt("gpt-125M-bs8", "125M", 8),
+        _gpt("gpt-125M-bs8-flash", "125M", 8, attention_impl="flash"),
+    ],
+    # ref "perf_test_manual" analog
+    "gpt.perf_test_manual": [
+        _gpt("gpt-125M-acc4", "125M", 32, nmb=4, method="shard"),
+    ],
+    "gpt.perf_test_auto": [
+        _gpt("gpt-125M-auto", "125M", 16, nmb=2, method="pipeshard"),
+    ],
+    "gpt.ladder": [
+        _gpt(f"gpt-{k}-bs8", k, 8) for k in ("125M", "350M")
+    ],
+    "moe.tiny": [
+        BenchmarkCase("moe-8e", "moe",
+                      dict(hidden_size=512, num_layers=8, num_heads=8,
+                           seq_len=512, vocab_size=32000, num_experts=8,
+                           expert_group_size=2048, moe_every=2),
+                      batch_size=8),
+    ],
+    "wresnet.tiny": [
+        BenchmarkCase("wresnet50-w2", "wresnet",
+                      dict(num_layers=50, width_factor=2, num_classes=1000),
+                      batch_size=32, dtype="float32"),
+    ],
+}

@@ -1,0 +1,40 @@
+"""BASELINE config #5 shape: serving a decoder LM over HTTP.
+
+  python examples/serve_gpt.py --port 8000
+  curl -X POST localhost:8000/completions \
+      -d '{"model": "gpt", "prompt_ids": [1,2,3], "max_new_tokens": 16}'
+"""
+import argparse
+import time
+
+import jax
+
+from alpa_tpu.model.gpt_model import GPTConfig
+from alpa_tpu.serve import get_model, run_controller
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--platform", default=None)
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--hidden", type=int, default=256)
+    parser.add_argument("--layers", type=int, default=4)
+    args = parser.parse_args()
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    config = GPTConfig(hidden_size=args.hidden, num_layers=args.layers,
+                       num_heads=8, seq_len=512, vocab_size=32000)
+    server = run_controller(port=args.port)
+    server.controller.register_model("gpt", get_model(config))
+    print(f"serving on http://127.0.0.1:{server.port}  "
+          f"(models: {server.controller.list_models()})")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main()

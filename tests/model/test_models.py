@@ -131,3 +131,49 @@ class TestWideResNet:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestUNetAndConformer:
+
+    def test_unet_forward_and_grad(self):
+        from alpa_tpu.model.unet_2d import UNet2D, UNetConfig
+        cfg = UNetConfig(block_channels=(16, 32), layers_per_block=1,
+                         attention_resolutions=(1,), num_heads=2,
+                         time_embed_dim=32)
+        model = UNet2D(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 16, 16, 3))
+        t = jnp.array([1, 5])
+        params = model.init(rng, x, t)
+        out = model.apply(params, x, t)
+        assert out.shape == (2, 16, 16, 3)
+        g = jax.grad(lambda p: (model.apply(p, x, t)**2).mean())(params)
+        assert np.isfinite(float(
+            jax.tree_util.tree_leaves(g)[0].sum()))
+
+    def test_conformer_forward_parallel(self):
+        from alpa_tpu.model.conformer import Conformer, ConformerConfig
+        cfg = ConformerConfig(hidden_size=64, num_layers=2, num_heads=4,
+                              conv_kernel_size=7)
+        model = Conformer(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (8, 32, 20))
+        params = model.init(rng, x)
+        out = model.apply(params, x)
+        assert out.shape == (8, 32, 64)
+        state = train_state.TrainState.create(apply_fn=model.apply,
+                                              params=params,
+                                              tx=optax.adam(1e-3))
+
+        @alpa_tpu.parallelize(method=ShardParallel())
+        def step(state, batch):
+
+            def loss_fn(p):
+                y = state.apply_fn(p, batch["x"])
+                return (y**2).mean()
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        s, l = step(state, {"x": x})
+        assert np.isfinite(float(l))

@@ -166,3 +166,20 @@ class TestCreateStateAndFollow:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestMonitoring:
+
+    def test_check_alive_and_dump(self, tmp_path):
+        from alpa_tpu.device_mesh import LocalPhysicalDeviceMesh
+        from alpa_tpu.monitoring import check_alive, dump_debug_info
+
+        mesh = LocalPhysicalDeviceMesh()
+        assert check_alive(mesh)
+
+        state, batch = create_mlp_train_state_and_batch()
+        step = get_mlp_train_step(DataParallel(), use_value_and_grad=True)
+        step(state, batch)
+        d = str(tmp_path / "dump")
+        dump_debug_info(step.get_last_executable(), d)
+        assert (tmp_path / "dump" / "compiled_hlo.txt").exists()

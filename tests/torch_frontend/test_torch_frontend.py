@@ -1,0 +1,130 @@
+"""Torch frontend: fx -> jax conversion parity with torch eager
+(ref alpa/torch tests)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax
+import jax.numpy as jnp
+
+import alpa_tpu
+from alpa_tpu.torch_frontend import functionalize, set_mode
+
+
+def _compare(module, *torch_inputs, rtol=1e-4):
+    fn, params = functionalize(module)
+    with torch.no_grad():
+        expected = module(*torch_inputs).numpy()
+    jax_inputs = [jnp.asarray(t.numpy()) for t in torch_inputs]
+    got = np.asarray(fn(params, *jax_inputs))
+    np.testing.assert_allclose(got, expected, rtol=rtol, atol=rtol)
+    return fn, params, jax_inputs
+
+
+class TestConversion:
+
+    def test_mlp(self):
+        m = torch.nn.Sequential(
+            torch.nn.Linear(16, 32), torch.nn.ReLU(),
+            torch.nn.Linear(32, 8), torch.nn.Softmax(dim=-1))
+        _compare(m, torch.randn(4, 16))
+
+    def test_functional_ops(self):
+
+        class Net(torch.nn.Module):
+
+            def __init__(self):
+                super().__init__()
+                self.fc = torch.nn.Linear(8, 8)
+
+            def forward(self, x):
+                h = torch.nn.functional.gelu(self.fc(x))
+                h = h.transpose(0, 1).contiguous()
+                h = h.view(-1)
+                return (h * 2 + 1).mean()
+
+        _compare(Net(), torch.randn(3, 8))
+
+    def test_embedding_layernorm(self):
+
+        class Net(torch.nn.Module):
+
+            def __init__(self):
+                super().__init__()
+                self.emb = torch.nn.Embedding(32, 16)
+                self.ln = torch.nn.LayerNorm(16)
+                self.head = torch.nn.Linear(16, 4)
+
+            def forward(self, ids):
+                return self.head(self.ln(self.emb(ids)))
+
+        m = Net()
+        fn, params = functionalize(m)
+        ids_t = torch.randint(0, 32, (2, 6))
+        with torch.no_grad():
+            expected = m(ids_t).numpy()
+        got = np.asarray(fn(params, jnp.asarray(ids_t.numpy())))
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-4)
+
+    def test_conv_bn_pool(self):
+        m = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 8, 3, padding=1),
+            torch.nn.BatchNorm2d(8),
+            torch.nn.ReLU(),
+            torch.nn.MaxPool2d(2),
+            torch.nn.Flatten(1),
+            torch.nn.Linear(8 * 4 * 4, 10),
+        ).eval()
+        _compare(m, torch.randn(2, 3, 8, 8))
+
+    def test_unmapped_op_clear_error(self):
+
+        class Net(torch.nn.Module):
+
+            def forward(self, x):
+                return torch.fft.fft(x).real
+
+        fn, params = functionalize(Net())
+        with pytest.raises(NotImplementedError, match="no jax mapping"):
+            fn(params, jnp.ones((4,)))
+
+
+class TestTrainConverted:
+
+    def test_train_torch_model_with_parallelize(self):
+        """The converted function trains under @alpa_tpu.parallelize."""
+        import optax
+
+        m = torch.nn.Sequential(torch.nn.Linear(16, 32), torch.nn.Tanh(),
+                                torch.nn.Linear(32, 1))
+        fn, params = functionalize(m)
+        set_mode("dist")
+        x = jnp.asarray(np.random.RandomState(0).randn(64, 16),
+                        jnp.float32)
+        y = jnp.asarray(np.random.RandomState(1).randn(64, 1), jnp.float32)
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(params)
+
+        @alpa_tpu.parallelize(method=alpa_tpu.DataParallel(),
+                              batch_argnums=(2, 3),
+                              donate_argnums=(0, 1))
+        def step(params, opt_state, x, y):
+
+            def loss_fn(p):
+                out = fn(p, x)
+                return ((out - y)**2).mean()
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        losses = []
+        for _ in range(10):
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
