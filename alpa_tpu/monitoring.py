@@ -60,10 +60,11 @@ class FailureWatchdog:
 
     def __init__(self, mesh_group, interval: float = 60.0,
                  on_failure=None):
+        import threading
         self.mesh_group = mesh_group
         self.interval = interval
         self.on_failure = on_failure or (lambda dead: None)
-        self._stop = False
+        self._stop = threading.Event()
         self._thread = None
 
     def start(self):
@@ -72,15 +73,19 @@ class FailureWatchdog:
         self._thread.start()
 
     def _loop(self):
-        while not self._stop:
+        while not self._stop.is_set():
             alive = check_mesh_group_alive(self.mesh_group)
+            if self._stop.is_set():
+                return  # stopped during the probe: don't fire callbacks
             dead = [i for i, a in enumerate(alive) if not a]
             if dead:
                 self.on_failure(dead)
-            time.sleep(self.interval)
+            self._stop.wait(self.interval)
 
     def stop(self):
-        self._stop = True
+        """Takes effect immediately: the loop wakes from its wait and no
+        further probes or callbacks run."""
+        self._stop.set()
 
 
 def dump_debug_info(executable, dump_dir: str):

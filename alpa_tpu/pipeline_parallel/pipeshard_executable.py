@@ -55,8 +55,10 @@ class StageExecutable:
             opt = as_option.copy()
             if logical_shape is not None:
                 opt.logical_mesh_shape = tuple(logical_shape)
-            jax_mesh, in_shardings, _cfn, _shape = plan_auto_sharding(
+            jax_mesh, in_shardings, cfn, _shape = plan_auto_sharding(
                 fun, avals, [""] * len(avals), [], physical_mesh, opt)
+            if cfn is not None:
+                fun = cfn  # realize the ILP plan inside the stage too
         else:
             from jax.sharding import NamedSharding, PartitionSpec
             lm = physical_mesh.get_logical_mesh(

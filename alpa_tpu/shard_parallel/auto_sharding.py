@@ -55,8 +55,9 @@ class AutoShardingOption:
     solver_timeout: int = 600
     # Logical mesh shape override, e.g. (2, 4).  None = physical shape.
     logical_mesh_shape: Optional[Tuple[int, ...]] = None
-    # Which flat args hold the data batch (used to pin the batch dim).
-    # Filled by the compile driver, not the user.
+    # Insert with_sharding_constraint on solved dot outputs so GSPMD
+    # follows the ILP exactly (auto-disabled when remat is present).
+    emit_sharding_constraints: bool = True
     mesh_shape_search: bool = False
 
     def copy(self):

@@ -87,7 +87,12 @@ def compile_shard_executable(
         jax_mesh, in_shardings, constraint_fn, _shape = plan_auto_sharding(
             fun, in_avals, in_paths, batch_flat_idx, physical_mesh,
             as_option)
-        if constraint_fn is not None:
+        # The constraint function re-evaluates eqns traced at *these*
+        # avals; the grad-accumulation rewrite retraces at microbatch
+        # shapes, so the two do not compose — prefer plain in_shardings +
+        # propagation there.
+        if constraint_fn is not None and not (num_micro_batches and
+                                              num_micro_batches > 1):
             fun = constraint_fn
     else:
         logical_mesh = _logical_mesh_for(physical_mesh, as_option)

@@ -125,4 +125,15 @@ def plan_auto_sharding(fun: Callable,
                         in_shardings[i] = shard_dim(jax_mesh, d, dp_axis_name,
                                                     len(aval.shape))
 
-    return jax_mesh, in_shardings, None, shape
+    # Emit with_sharding_constraint on solved dot outputs so GSPMD realizes
+    # the ILP's intra-op plan exactly.  Skipped when a remat/checkpoint
+    # boundary was inlined for analysis (re-evaluating the flattened eqns
+    # would lose rematerialization) or when disabled by option.
+    constraint_fn = None
+    if option.emit_sharding_constraints and not graph.has_remat:
+        from alpa_tpu.shard_parallel.strategy import make_constrained_fun
+        constraint_fn = make_constrained_fun(graph, choice, jax_mesh,
+                                             axis_names,
+                                             closed_jaxpr.consts)
+
+    return jax_mesh, in_shardings, constraint_fn, shape

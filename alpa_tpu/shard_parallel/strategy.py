@@ -146,18 +146,41 @@ class StrategyGraph:
 def _subst(v, env):
     if isinstance(v, Literal):
         return v
-    return env.get(v, v)
+    seen = 0
+    while v in env and env[v] is not v and seen < 100:
+        nxt = env[v]
+        if isinstance(nxt, Literal):
+            return nxt
+        v = nxt
+        seen += 1
+    return v
 
 
 def flatten_jaxpr_eqns(jaxpr: Jaxpr, env: Optional[dict] = None,
-                       depth: int = 0) -> List:
+                       depth: int = 0, info: Optional[dict] = None) -> List:
     """Inline pjit/custom-call/remat sub-jaxprs, returning a flat eqn list
-    over substituted vars.  Scan/while/cond are left opaque (barriers)."""
-    env = env or {}
+    over substituted vars.  Scan/while/cond are left opaque (barriers).
+
+    ``info`` (optional dict) collects side data for re-evaluation:
+    ``captured_consts`` (inner constvar -> value), ``has_remat`` (whether a
+    checkpoint boundary was inlined away), and ``env`` (the substitution,
+    for resolving outer outvars of inlined calls).
+    """
+    env = env if env is not None else {}
+    if info is not None:
+        info.setdefault("captured_consts", {})
+        info.setdefault("has_remat", False)
+        if depth == 0:
+            # only the top-level substitution maps outer outvars; inner
+            # envs must not clobber it
+            info["env"] = env
     out = []
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
         if prim in INLINE_PRIMS and depth < 6:
+            if info is not None and prim in ("remat", "checkpoint",
+                                             "remat2"):
+                info["has_remat"] = True
             sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or
                    eqn.params.get("fun_jaxpr"))
             if sub is None:
@@ -180,15 +203,43 @@ def flatten_jaxpr_eqns(jaxpr: Jaxpr, env: Optional[dict] = None,
             for iv, ov in zip(inner_invars, aligned):
                 if ov is not None:
                     inner_env[iv] = ov
-            for cv in sub_jaxpr.constvars:
-                # consts become opaque leaf vars (replicated barriers)
+            for ci, cv in enumerate(sub_jaxpr.constvars):
+                # consts become opaque leaf vars (replicated barriers);
+                # record their values for re-evaluation
                 inner_env[cv] = cv
-            inner_eqns = flatten_jaxpr_eqns(sub_jaxpr, inner_env, depth + 1)
-            out.extend(inner_eqns)
-            # map eqn outvars to inner outvars
+                if info is not None and ci < len(consts):
+                    info["captured_consts"][cv] = consts[ci]
+            inner_eqns = flatten_jaxpr_eqns(sub_jaxpr, inner_env, depth + 1,
+                                            info)
+            # Freshen every var DEFINED inside this inline site: jax caches
+            # traced sub-jaxprs, so two calls of the same function share
+            # inner Var objects — without freshening, the second site's
+            # eqns would collide with (and overwrite) the first's.
+            from alpa_tpu.util import gensym_var
+            fresh = {}
+
+            def _fresh(v):
+                if isinstance(v, Literal):
+                    return v
+                return fresh.get(v, v)
+
+            freshened = []
+            for ie in inner_eqns:
+                new_outs = []
+                for ov2 in ie.outvars:
+                    nv = gensym_var(ov2.aval)
+                    fresh[ov2] = nv
+                    new_outs.append(nv)
+                freshened.append(
+                    ie.replace(invars=[_fresh(v) for v in ie.invars],
+                               outvars=new_outs))
+            out.extend(freshened)
+            # map eqn outvars to (freshened) inner outvars
             for ov, inner_ov in zip(eqn.outvars, sub_jaxpr.outvars):
-                env[ov] = _subst(inner_ov, inner_env) \
-                    if not isinstance(inner_ov, Literal) else inner_ov
+                if isinstance(inner_ov, Literal):
+                    env[ov] = inner_ov
+                else:
+                    env[ov] = _fresh(_subst(inner_ov, inner_env))
         else:
             out.append(eqn.replace(
                 invars=[_subst(v, env) for v in eqn.invars],
@@ -512,7 +563,8 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                 len(v.aval.shape) if hasattr(v.aval, "shape") else 0))
         return var_node[v]
 
-    flat_eqns = flatten_jaxpr_eqns(jaxpr)
+    flatten_info: Dict = {}
+    flat_eqns = flatten_jaxpr_eqns(jaxpr, info=flatten_info)
 
     for eqn in flat_eqns:
         prim = eqn.primitive.name
@@ -664,4 +716,95 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                     C = edge_cost_matrix(nodes[src_idx], dimmap, v.aval, req)
                     edges.append(Edge(src_idx, n.idx, C))
 
-    return StrategyGraph(nodes, edges, logical_mesh)
+    graph = StrategyGraph(nodes, edges, logical_mesh)
+    graph.flat_eqns = flat_eqns
+    graph.invars = list(jaxpr.invars)
+    graph.constvars = list(jaxpr.constvars)
+    sub_env = flatten_info.get("env", {})
+    graph.outvars = [_subst(v, sub_env) for v in jaxpr.outvars]
+    graph.captured_consts = flatten_info.get("captured_consts", {})
+    graph.has_remat = flatten_info.get("has_remat", False)
+    return graph
+
+
+def make_constrained_fun(graph: StrategyGraph, choice, jax_mesh,
+                         axis_names, consts):
+    """Build a function that re-evaluates the (flattened) jaxpr inserting
+    ``with_sharding_constraint`` on every solved dot output — so GSPMD
+    realizes exactly the ILP's intra-op plan instead of relying on
+    propagation (the fidelity upgrade promised by this module's header).
+
+    The flattened eqn list is post-autodiff (planning happens on the traced
+    train step), so evaluating inlined custom-vjp/pjit bodies directly is
+    semantically equivalent; non-inlined eqns (scan/while/...) are bound
+    as-is.
+    """
+    import jax as _jax
+    from alpa_tpu.shard_parallel.sharding_spec import (is_replicated,
+                                                       spec_to_partition_spec)
+
+    # dot outvar -> NamedSharding of the chosen strategy
+    constraints = {}
+    for node, s in zip(graph.nodes, choice):
+        if node.kind == "op" and node.outvar is not None:
+            spec = node.strategies[s].out_spec
+            if not is_replicated(spec):
+                from jax.sharding import NamedSharding
+                constraints[node.outvar] = NamedSharding(
+                    jax_mesh, spec_to_partition_spec(spec, axis_names))
+    if not constraints:
+        return None
+
+    flat_eqns = graph.flat_eqns
+    invars = graph.invars
+    constvars = graph.constvars
+    outvars = graph.outvars
+    captured = graph.captured_consts
+
+    # Validate the flattened view is complete: every outvar and eqn invar
+    # must be defined.  If not (an inlining pattern we don't model), skip
+    # constraint emission rather than failing at trace time.
+    defined = set(invars) | set(constvars) | set(captured)
+    for e in flat_eqns:
+        defined.update(e.outvars)
+    bad = [v for v in outvars if isinstance(v, Var) and v not in defined]
+    for e in flat_eqns:
+        for v in e.invars:
+            if isinstance(v, Var) and v not in defined:
+                bad.append(v)
+    if bad:
+        logger.debug(
+            "skipping sharding-constraint emission: %d unresolved vars "
+            "(first: %s)", len(bad), bad[0])
+        return None
+
+    def constrained(*args):
+        env = {}
+        for v, a in zip(invars, args):
+            env[v] = a
+        for v, c in zip(constvars, consts):
+            env[v] = c
+        env.update(captured)
+
+        def read(v):
+            if isinstance(v, Literal):
+                return v.val
+            return env[v]
+
+        for eqn in flat_eqns:
+            if eqn.primitive.name == "pipeline":
+                for iv, ov in zip(eqn.invars, eqn.outvars):
+                    env[ov] = read(iv)
+                continue
+            vals = [read(v) for v in eqn.invars]
+            ans = eqn.primitive.bind(*vals, **eqn.params)
+            if not eqn.primitive.multiple_results:
+                ans = [ans]
+            for ov, a in zip(eqn.outvars, ans):
+                if ov in constraints:
+                    a = _jax.lax.with_sharding_constraint(
+                        a, constraints[ov])
+                env[ov] = a
+        return [read(v) for v in outvars]
+
+    return constrained

@@ -6,16 +6,17 @@ symbolic trace is converted node-by-node into a pure jax function over a
 params pytree (the module's state_dict), which then goes through
 ``@alpa_tpu.parallelize`` like any jax function.
 """
-from alpa_tpu.torch_frontend.converter import (functionalize, fx_to_jax,
+from alpa_tpu.torch_frontend.converter import (fx_to_jax,
                                                torch_to_jax_array)
+from alpa_tpu.torch_frontend.converter import functionalize as _functionalize
 
 _mode = "local"
 
 
 def set_mode(mode: str):
-    """"local" = run converted functions on one device for debugging;
-    "dist" = hand them to alpa_tpu.parallelize (ref torch/__init__.py:33).
-    """
+    """"local": ``functionalize`` returns a jit-wrapped function for
+    single-device debugging.  "dist": the function is returned pure, ready
+    for ``@alpa_tpu.parallelize`` (ref torch/__init__.py:33)."""
     global _mode
     assert mode in ("local", "dist")
     _mode = mode
@@ -23,3 +24,14 @@ def set_mode(mode: str):
 
 def get_mode() -> str:
     return _mode
+
+
+def functionalize(module, concrete_args=None):
+    """torch.nn.Module -> (jax_fn, params).  In "local" mode the function
+    comes back jit-wrapped; in "dist" mode it is left pure for
+    parallelize."""
+    import jax
+    fn, params = _functionalize(module, concrete_args)
+    if _mode == "local":
+        return jax.jit(fn), params
+    return fn, params

@@ -124,6 +124,21 @@ def _contiguous(x):
     return x
 
 
+def _torch_max(x, dim=None, keepdim=False):
+    if dim is None:
+        return jnp.max(x)
+    # torch returns (values, indices) when dim is given
+    return (jnp.max(x, axis=dim, keepdims=keepdim),
+            jnp.argmax(x, axis=dim, keepdims=keepdim))
+
+
+def _torch_min(x, dim=None, keepdim=False):
+    if dim is None:
+        return jnp.min(x)
+    return (jnp.min(x, axis=dim, keepdims=keepdim),
+            jnp.argmin(x, axis=dim, keepdims=keepdim))
+
+
 def _max_pool2d(x, kernel_size, stride=None, padding=0, **_):
     if isinstance(kernel_size, int):
         kernel_size = (kernel_size, kernel_size)
@@ -172,8 +187,8 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "abs": jnp.abs,
     "mean": _mean,
     "sum": _sum,
-    "max": lambda x, *a, **k: jnp.max(x, *a, **k),
-    "min": lambda x, *a, **k: jnp.min(x, *a, **k),
+    "max": lambda x, *a, **k: _torch_max(x, *a, **k),
+    "min": lambda x, *a, **k: _torch_min(x, *a, **k),
     "cat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     "stack": lambda ts, dim=0: jnp.stack(ts, axis=dim),
     "split": lambda x, n, dim=0: jnp.split(
@@ -294,6 +309,13 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
     if missing:
         raise KeyError(f"params dict missing fx get_attr targets: "
                        f"{missing}")
+    # Convert every module once at conversion time: unmapped modules fail
+    # here (the documented contract), and calls avoid per-invocation
+    # isinstance dispatch.
+    module_fns = {
+        n.target: _convert_module(modules[n.target], n.target + ".")
+        for n in gm.graph.nodes if n.op == "call_module"
+    }
 
     def fn(p, *inputs):
         env: Dict[str, Any] = {}
@@ -334,8 +356,7 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
                 kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
                 env[node.name] = f(*args, **kwargs)
             elif node.op == "call_module":
-                mod = modules[node.target]
-                mf = _convert_module(mod, node.target + ".")
+                mf = module_fns[node.target]
                 args = [lookup(a) for a in node.args]
                 env[node.name] = mf(p, *args)
             elif node.op == "output":

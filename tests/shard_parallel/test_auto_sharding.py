@@ -87,3 +87,67 @@ class TestAutoShardingChoices:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestConstraintEmission:
+
+    def test_constrained_eval_shared_subjaxprs(self):
+        """jax caches traced sub-jaxprs: two relu calls share inner Vars.
+        The flattened evaluator must freshen per inline site (regression:
+        second site overwrote the first's values)."""
+        from jax.extend.core import Literal
+
+        from alpa_tpu.shard_parallel.strategy import (_subst,
+                                                      flatten_jaxpr_eqns)
+
+        def f(x, w1, b1, w2, b2):
+            h1 = jax.nn.relu(x @ w1 + b1)
+            h2 = jax.nn.relu(h1 @ w2 + b2)
+            return h1, h1 > 0, h2, h2 > 0
+
+        avals = [
+            jax.ShapeDtypeStruct(s, jnp.float32)
+            for s in [(4, 8), (8, 8), (8,), (8, 8), (8,)]
+        ]
+        cj = jax.make_jaxpr(f)(*avals)
+        info = {}
+        flat = flatten_jaxpr_eqns(cj.jaxpr, info=info)
+        rs = np.random.RandomState(0)
+        args = [jnp.asarray(rs.randn(*a.shape).astype(np.float32))
+                for a in avals]
+        want = f(*args)
+        env = dict(zip(cj.jaxpr.invars, args))
+        env.update(zip(cj.jaxpr.constvars, cj.consts))
+        env.update(info["captured_consts"])
+
+        def read(v):
+            return v.val if isinstance(v, Literal) else env[v]
+
+        for e in flat:
+            if e.primitive.name == "pipeline":
+                for iv, ov in zip(e.invars, e.outvars):
+                    env[ov] = read(iv)
+                continue
+            vals = [read(v) for v in e.invars]
+            ans = e.primitive.bind(*vals, **e.params)
+            if not e.primitive.multiple_results:
+                ans = [ans]
+            for ov, a in zip(e.outvars, ans):
+                env[ov] = a
+        for i, v in enumerate(cj.jaxpr.outvars):
+            got = env[_subst(v, info["env"])]
+            np.testing.assert_array_equal(
+                np.asarray(want[i]), np.asarray(got))
+
+    def test_emission_observable_and_correct(self):
+        from alpa_tpu import AutoShardingOption
+
+        ex_on = _train_and_get_executable(
+            8, 2048,
+            ShardParallel(auto_sharding_option=AutoShardingOption(
+                emit_sharding_constraints=True)))
+        ex_off = _train_and_get_executable(
+            8, 2048,
+            ShardParallel(auto_sharding_option=AutoShardingOption(
+                emit_sharding_constraints=False)))
+        assert ex_on is not None and ex_off is not None
