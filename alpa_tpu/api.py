@@ -58,8 +58,10 @@ def _abstractify(x):
     if hasattr(x, "aval"):
         a = x.aval
         return jax.ShapeDtypeStruct(a.shape, a.dtype)
-    x = np.asarray(x)
-    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+    # canonicalize like jax tracing would (python int -> int32 when x64
+    # is off), so cache keys from host values match device round-trips
+    a = shaped_abstractify(x)
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
 
 class ParallelizedFunc:

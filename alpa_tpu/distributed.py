@@ -1,0 +1,99 @@
+"""Multi-host (TPU pod) bring-up helpers.
+
+Analog of the reference's distributed XLA runtime bootstrap (SURVEY.md
+§2.9: ``get_distributed_runtime_service/client`` + per-host Ray workers,
+device_mesh.py:1057-1148).  On TPU pods the runtime is jax's own:
+``jax.distributed.initialize`` connects every host process to the
+coordinator, after which ``jax.devices()`` is the global pod view and all
+of alpa_tpu's meshes/compile paths work unchanged — intra-mesh collectives
+ride ICI, cross-mesh transfers ride DCN.
+
+Typical pod usage (same script on every host):
+
+    import alpa_tpu.distributed as dist
+    dist.initialize()                    # TPU pods: args auto-detected
+    alpa_tpu.init(cluster="distributed")
+"""
+import logging
+import os
+from typing import Optional, Sequence
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None,
+               local_device_ids: Optional[Sequence[int]] = None):
+    """Connect this host to the pod (idempotent).
+
+    On Cloud TPU all arguments are auto-detected from the metadata server;
+    elsewhere pass them explicitly or via the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``,
+    ``JAX_PROCESS_ID``).
+    """
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return
+    kwargs = {}
+    if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        kwargs["coordinator_address"] = (
+            coordinator_address or os.environ["JAX_COORDINATOR_ADDRESS"])
+    if num_processes or os.environ.get("JAX_NUM_PROCESSES"):
+        kwargs["num_processes"] = int(
+            num_processes or os.environ["JAX_NUM_PROCESSES"])
+    if process_id is not None or os.environ.get("JAX_PROCESS_ID"):
+        kwargs["process_id"] = int(
+            process_id if process_id is not None else
+            os.environ["JAX_PROCESS_ID"])
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    try:
+        jax.distributed.initialize(**kwargs)
+        _initialized = True
+        logger.info("jax.distributed initialized: process %d/%d, %d local "
+                    "of %d global devices", jax.process_index(),
+                    jax.process_count(), jax.local_device_count(),
+                    jax.device_count())
+    except Exception as e:
+        # single-process runs (tests, one host) are fine without it
+        logger.info("jax.distributed.initialize skipped: %s", e)
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def sync_global_devices(tag: str = "barrier"):
+    """Cross-host barrier (analog of the reference's sync RPCs)."""
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
+
+
+def broadcast_from_coordinator(pytree):
+    """Make host-0's values visible on every host."""
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(pytree)

@@ -22,28 +22,40 @@ logger = logging.getLogger(__name__)
 
 
 def solve_strategy_graph(graph: StrategyGraph,
-                         time_limit: float = None) -> List[int]:
+                         time_limit: float = None,
+                         memory_budget: float = None) -> List[int]:
     """Pick one strategy per node minimizing total cost.
 
-    Returns chosen strategy index per node.
+    ``memory_budget``: optional per-device byte cap — adds the constraint
+    sum(mem_bytes[i, s] * x[i, s]) <= budget over invar nodes (the analog
+    of ref auto_sharding's memory_budget_per_device).  Returns chosen
+    strategy index per node.
     """
     time_limit = time_limit or global_config.ilp_time_limit
     n_nodes = len(graph.nodes)
     sizes = [len(n.strategies) for n in graph.nodes]
 
     # Trivial case: everything has one strategy.
-    if all(s == 1 for s in sizes):
+    if all(s == 1 for s in sizes) and not memory_budget:
         return [0] * n_nodes
 
     try:
-        return _solve_milp(graph, sizes, time_limit)
+        return _solve_milp(graph, sizes, time_limit, memory_budget)
     except Exception as e:  # pylint: disable=broad-except
-        logger.warning("MILP solve failed (%s); using greedy fallback", e)
-        return _solve_greedy(graph, sizes)
+        if memory_budget:
+            logger.warning(
+                "MILP solve failed (%s); greedy fallback enforces the "
+                "memory budget only greedily — the %d-byte cap may be "
+                "exceeded", e, int(memory_budget))
+        else:
+            logger.warning("MILP solve failed (%s); using greedy fallback",
+                           e)
+        return _solve_greedy(graph, sizes, memory_budget)
 
 
 def _solve_milp(graph: StrategyGraph, sizes: List[int],
-                time_limit: float) -> List[int]:
+                time_limit: float,
+                memory_budget: float = None) -> List[int]:
     from scipy.optimize import Bounds, LinearConstraint, milp
     from scipy.sparse import lil_matrix
 
@@ -70,12 +82,24 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
     scale = max(1.0, np.abs(c).max() / 1e4)
     c = c / scale
 
+    has_mem = bool(memory_budget)
     n_cons = len(graph.nodes) + sum(
-        sizes[e.src] + sizes[e.dst] for e in graph.edges)
+        sizes[e.src] + sizes[e.dst] for e in graph.edges) + (
+            1 if has_mem else 0)
     A = lil_matrix((n_cons, n_vars))
     lb = np.zeros(n_cons)
     ub = np.zeros(n_cons)
     row = 0
+    if has_mem:
+        # sum over invar nodes of per-strategy resident bytes <= budget
+        for n, o in zip(graph.nodes, node_off):
+            if n.kind != "invar":
+                continue
+            for s, st in enumerate(n.strategies):
+                A[row, o + s] = st.mem_bytes
+        lb[row] = -np.inf
+        ub[row] = float(memory_budget)
+        row += 1
     # sum_s x[i,s] = 1
     for i, o in enumerate(node_off):
         A[row, o:o + sizes[i]] = 1.0
@@ -119,11 +143,18 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
     return choice
 
 
-def _solve_greedy(graph: StrategyGraph, sizes: List[int]) -> List[int]:
+def _solve_greedy(graph: StrategyGraph, sizes: List[int],
+                  memory_budget: float = None) -> List[int]:
     """Greedy: process nodes in index order (invars first, then ops in
     program order), choosing the strategy with minimal marginal cost against
-    already-decided neighbors; then one refinement sweep."""
+    already-decided neighbors; then one refinement sweep.
+
+    ``memory_budget``: soft enforcement — a per-byte penalty is charged on
+    invar strategies once the running resident total exceeds the budget,
+    pushing further choices toward sharded layouts (best effort, unlike the
+    MILP's hard constraint)."""
     choice = [0] * len(graph.nodes)
+    mem_used = [0.0]
     decided = [False] * len(graph.nodes)
     in_edges: Dict[int, List] = {}
     out_edges: Dict[int, List] = {}
@@ -132,7 +163,11 @@ def _solve_greedy(graph: StrategyGraph, sizes: List[int]) -> List[int]:
         out_edges.setdefault(e.src, []).append(e)
 
     def marginal(i, s):
-        cost = graph.nodes[i].strategies[s].comm_cost
+        st = graph.nodes[i].strategies[s]
+        cost = st.comm_cost
+        if memory_budget and graph.nodes[i].kind == "invar":
+            over = max(0.0, mem_used[0] + st.mem_bytes - memory_budget)
+            cost += over * 1e3  # strongly prefer staying under budget
         for e in in_edges.get(i, ()):
             if decided[e.src]:
                 cost += e.cost[choice[e.src], s]
@@ -147,6 +182,8 @@ def _solve_greedy(graph: StrategyGraph, sizes: List[int]) -> List[int]:
         costs = [marginal(i, s) for s in range(sizes[i])]
         choice[i] = int(np.argmin(costs))
         decided[i] = True
+        if memory_budget and graph.nodes[i].kind == "invar":
+            mem_used[0] += graph.nodes[i].strategies[choice[i]].mem_bytes
     # refinement sweep
     for _ in range(2):
         for i in range(len(graph.nodes)):

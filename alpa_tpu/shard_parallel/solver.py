@@ -69,7 +69,8 @@ def plan_auto_sharding(fun: Callable,
         logical_mesh = physical_mesh.get_logical_mesh(shape)
         graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
                                      batch_flat_idx, option)
-        choice = solve_strategy_graph(graph, option.solver_timeout)
+        choice = solve_strategy_graph(graph, option.solver_timeout,
+                                      option.memory_budget_per_device)
         cost = solution_cost(graph, choice)
         logger.debug("mesh shape %s: cost %.4f (%s)", shape, cost,
                      graph.stats())

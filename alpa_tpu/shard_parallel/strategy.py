@@ -102,6 +102,9 @@ class Strategy:
     comm_cost: float
     # required operand specs, parallel to the node's operand list
     operand_specs: Tuple[Spec, ...] = ()
+    # resident bytes per device under this strategy (invar nodes: the
+    # sharded parameter bytes; used by the ILP memory constraint)
+    mem_bytes: float = 0.0
 
 
 @dataclasses.dataclass
@@ -524,7 +527,12 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                 replicated_spec(len(aval.shape))
             if spec_valid(aval, forced, mesh_shape):
                 specs = (forced,)
-        strategies = [Strategy(str(s), s, 0.0) for s in specs]
+        from alpa_tpu.shard_parallel.sharding_spec import sharded_bytes
+        strategies = [
+            Strategy(str(s), s, 0.0,
+                     mem_bytes=sharded_bytes(aval, s, mesh_shape))
+            for s in specs
+        ]
         n = new_node("invar", aval, strategies, f"invar{i}", invar_idx=i)
         var_node[v] = (n.idx, identity_dimmap(len(aval.shape)))
 

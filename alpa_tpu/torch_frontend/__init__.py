@@ -27,11 +27,19 @@ def get_mode() -> str:
 
 
 def functionalize(module, concrete_args=None):
-    """torch.nn.Module -> (jax_fn, params).  In "local" mode the function
-    comes back jit-wrapped; in "dist" mode it is left pure for
-    parallelize."""
+    """torch.nn.Module -> (jax_fn, params).
+
+    The mode is consulted at CALL time, so ``set_mode`` may be called
+    before or after conversion: "local" runs the function under jax.jit
+    for single-device debugging; "dist" runs it pure (parallelize-ready).
+    """
+    import functools
     import jax
     fn, params = _functionalize(module, concrete_args)
-    if _mode == "local":
-        return jax.jit(fn), params
-    return fn, params
+    jitted = jax.jit(fn)
+
+    @functools.wraps(fn)
+    def dispatch(p, *inputs):
+        return (jitted if _mode == "local" else fn)(p, *inputs)
+
+    return dispatch, params

@@ -187,8 +187,8 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "abs": jnp.abs,
     "mean": _mean,
     "sum": _sum,
-    "max": lambda x, *a, **k: _torch_max(x, *a, **k),
-    "min": lambda x, *a, **k: _torch_min(x, *a, **k),
+    "max": _torch_max,
+    "min": _torch_min,
     "cat": lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
     "stack": lambda ts, dim=0: jnp.stack(ts, axis=dim),
     "split": lambda x, n, dim=0: jnp.split(

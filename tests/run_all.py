@@ -1,0 +1,58 @@
+"""Run every test file in its own subprocess.
+
+Analog of ref ``tests/run_all.py`` (SURVEY.md §4): per-file process
+isolation (fresh jax runtime per file), timeout per file, run/skip
+patterns.
+
+  python tests/run_all.py [--run-pattern PAT] [--skip-pattern PAT]
+                          [--timeout SECONDS]
+"""
+import argparse
+import glob
+import os
+import subprocess
+import sys
+import time
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--run-pattern", default=None)
+    parser.add_argument("--skip-pattern", default=None)
+    parser.add_argument("--timeout", type=int, default=1000)
+    args = parser.parse_args()
+
+    test_dir = os.path.dirname(os.path.abspath(__file__))
+    files = sorted(
+        glob.glob(os.path.join(test_dir, "**", "test_*.py"),
+                  recursive=True))
+    if args.run_pattern:
+        files = [f for f in files if args.run_pattern in f]
+    if args.skip_pattern:
+        files = [f for f in files if args.skip_pattern not in f]
+
+    failed = []
+    for f in files:
+        rel = os.path.relpath(f, test_dir)
+        tic = time.time()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-m", "pytest", f, "-x", "-q"],
+                timeout=args.timeout,
+                cwd=os.path.dirname(test_dir))
+            ok = r.returncode == 0
+        except subprocess.TimeoutExpired:
+            ok = False
+        status = "PASS" if ok else "FAIL"
+        print(f"[{status}] {rel} ({time.time() - tic:.1f}s)", flush=True)
+        if not ok:
+            failed.append(rel)
+
+    print(f"\n{len(files) - len(failed)}/{len(files)} files passed")
+    if failed:
+        print("failed:", failed)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Runtime misc: memory-leak check, seed reproducibility
+(ref tests/runtime/test_memory_leak.py + random-seed tests)."""
+import gc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import alpa_tpu
+from alpa_tpu import DataParallel, ShardParallel
+from alpa_tpu.testing import (assert_allclose, create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+class TestMemoryLeak:
+
+    def test_no_buffer_growth_across_steps(self):
+        """Steady-state training must not accumulate live device buffers
+        (ref test_memory_leak.py)."""
+        state, batch = create_mlp_train_state_and_batch()
+        step = get_mlp_train_step(DataParallel(), use_value_and_grad=True)
+        for _ in range(3):
+            state, loss = step(state, batch)
+        gc.collect()
+        n0 = len(jax.live_arrays())
+        for _ in range(10):
+            state, loss = step(state, batch)
+        float(loss)
+        gc.collect()
+        n1 = len(jax.live_arrays())
+        assert n1 <= n0 + 4, f"live arrays grew {n0} -> {n1}"
+
+    def test_executable_cache_bounded(self):
+        """Same shapes -> one cached executable, not one per call."""
+        state, batch = create_mlp_train_state_and_batch()
+        step = get_mlp_train_step(ShardParallel(), use_value_and_grad=True)
+        for _ in range(4):
+            state, _ = step(state, batch)
+        assert len(step._executable_cache) == 1
+
+
+class TestSeedReproducibility:
+
+    def test_same_seed_same_init(self):
+        alpa_tpu.set_seed(123)
+        s1, _ = create_mlp_train_state_and_batch()
+        alpa_tpu.set_seed(123)
+        s2, _ = create_mlp_train_state_and_batch()
+        assert_allclose(jax.device_get(s1.params), jax.device_get(s2.params))
+
+    def test_training_deterministic(self):
+        outs = []
+        for _ in range(2):
+            state, batch = create_mlp_train_state_and_batch()
+            step = get_mlp_train_step(DataParallel(),
+                                      use_value_and_grad=True)
+            for _ in range(3):
+                state, loss = step(state, batch)
+            outs.append(float(loss))
+        assert outs[0] == outs[1]
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

@@ -151,3 +151,23 @@ class TestConstraintEmission:
             ShardParallel(auto_sharding_option=AutoShardingOption(
                 emit_sharding_constraints=False)))
         assert ex_on is not None and ex_off is not None
+
+    def test_memory_budget_forces_sharding(self):
+        """A per-device byte budget makes the ILP shard more inputs than
+        the unconstrained plan (ref memory_budget_per_device)."""
+        from alpa_tpu import AutoShardingOption
+
+        def count_nonreplicated(budget):
+            state, batch = create_mlp_train_state_and_batch(
+                batch_size=2048, input_dim=64, hidden_dim=64, output_dim=64)
+            opt = (AutoShardingOption(memory_budget_per_device=budget)
+                   if budget else AutoShardingOption())
+            step = get_mlp_train_step(
+                ShardParallel(auto_sharding_option=opt),
+                use_value_and_grad=True)
+            step(state, batch)
+            ex = step.get_last_executable()
+            return sum(1 for s in ex.in_shardings
+                       if str(s.spec) != "PartitionSpec()")
+
+        assert count_nonreplicated(200_000) > count_nonreplicated(None)
