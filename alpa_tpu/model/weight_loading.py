@@ -1,0 +1,110 @@
+"""Load HuggingFace transformer weights into alpa_tpu models.
+
+Analog of ref ``examples/llm_serving/model/opt_model.py:865``
+(``load_opt_params_worker_func`` — distributed weight loading into sharded
+buffers): a HF GPT-2-family state dict converts into our ``GPTModel``
+params, optionally placed directly with target shardings so large models
+materialize distributed (each host/device writes only its shard via
+``jax.device_put``'s addressable-shard semantics).
+"""
+import logging
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from alpa_tpu.model.gpt_model import GPTConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def convert_gpt2_state_dict(state_dict: Dict[str, Any],
+                            config: GPTConfig) -> Dict:
+    """HF GPT-2 state dict -> alpa_tpu GPTModel params.
+
+    HF GPT-2 uses Conv1D layers whose weights are already (in, out), so
+    they map directly onto flax Dense kernels.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def get(key):
+        out = sd.get(key, sd.get("transformer." + key))
+        if out is None:
+            raise KeyError(
+                f"state dict has neither {key!r} nor "
+                f"{'transformer.' + key!r} — not a GPT-2-family checkpoint?")
+        return out
+
+    params = {
+        "wte": {"embedding": get("wte.weight")},
+        "wpe": {"embedding": get("wpe.weight")[:config.seq_len]},
+        "ln_f": {"scale": get("ln_f.weight"), "bias": get("ln_f.bias")},
+    }
+    for i in range(config.num_layers):
+        p = f"h.{i}."
+        params[f"h{i}"] = {
+            "ln1": {"scale": get(p + "ln_1.weight"),
+                    "bias": get(p + "ln_1.bias")},
+            "ln2": {"scale": get(p + "ln_2.weight"),
+                    "bias": get(p + "ln_2.bias")},
+            "attn": {
+                "qkv": {"kernel": get(p + "attn.c_attn.weight"),
+                        "bias": get(p + "attn.c_attn.bias")},
+                "out": {"kernel": get(p + "attn.c_proj.weight"),
+                        "bias": get(p + "attn.c_proj.bias")},
+            },
+            "mlp": {
+                "fc_in": {"kernel": get(p + "mlp.c_fc.weight"),
+                          "bias": get(p + "mlp.c_fc.bias")},
+                "fc_out": {"kernel": get(p + "mlp.c_proj.weight"),
+                           "bias": get(p + "mlp.c_proj.bias")},
+            },
+        }
+    return {"params": params}
+
+
+def config_from_hf_gpt2(hf_config) -> GPTConfig:
+    return GPTConfig(vocab_size=hf_config.vocab_size,
+                     hidden_size=hf_config.n_embd,
+                     num_layers=hf_config.n_layer,
+                     num_heads=hf_config.n_head,
+                     seq_len=hf_config.n_positions,
+                     tie_embeddings=True)
+
+
+def load_gpt2(model_name_or_model,
+              dtype=jnp.float32,
+              shardings: Optional[Any] = None):
+    """Build (GPTModel, params, config) from a HF GPT-2 model or name.
+
+    ``shardings``: optional params-pytree of NamedShardings — each leaf is
+    device_put directly with its target sharding (the distributed-loading
+    path: no full replica ever materializes per device).
+    """
+    from alpa_tpu.model.gpt_model import GPTModel
+
+    if isinstance(model_name_or_model, str):
+        from transformers import GPT2LMHeadModel
+        hf_model = GPT2LMHeadModel.from_pretrained(model_name_or_model)
+    else:
+        hf_model = model_name_or_model
+    config = config_from_hf_gpt2(hf_model.config)
+    params = convert_gpt2_state_dict(hf_model.state_dict(), config)
+    if shardings is not None:
+        # leaves stay numpy until device_put with the TARGET sharding —
+        # no full per-device replica ever materializes
+        params = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(np.asarray(x, dtype), s)
+            if s is not None else jnp.asarray(x, dtype),
+            params, shardings)
+    else:
+        params = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, dtype), params)
+    return GPTModel(config), params, config

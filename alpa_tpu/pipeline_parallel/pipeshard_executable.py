@@ -568,6 +568,15 @@ class PipeshardDriverExecutable:
     def get_instruction_text(self) -> str:
         return "\n".join(repr(i) for i in self.instructions)
 
+    def dump_stage_execution_trace(self, filename: str):
+        """Write the collected tracer events as a Chrome trace JSON
+        (ref dump_stage_execution_trace_internal,
+        pipeshard_executable.py:592).  Requires
+        global_config.collect_trace=True during execution."""
+        import json
+        with open(filename, "w", encoding="utf-8") as f:
+            json.dump({"traceEvents": tracer.to_chrome_trace()}, f)
+
     def get_resharding_report(self) -> str:
         """Planned cross-mesh traffic per step (tile-level accounting from
         cross_mesh_resharding.plan_resharding)."""

@@ -69,6 +69,11 @@ class Generator:
 
         self._prefill = jax.jit(prefill)
         self._decode = jax.jit(decode)
+        # beam-search KV-cache gather, compiled once (per cache shapes)
+        self._reorder = jax.jit(
+            lambda caches, idx: jax.tree_util.tree_map(
+                lambda x: jnp.take(x, idx, axis=0)
+                if hasattr(x, "ndim") and x.ndim > 0 else x, caches))
 
     def generate(self,
                  input_ids: np.ndarray,
@@ -101,6 +106,70 @@ class Generator:
             if cfg.eos_token_id is not None and bool(finished.all()):
                 break
         return np.asarray(jnp.concatenate(tokens, axis=1))
+
+
+    def generate_beam(self,
+                      input_ids: np.ndarray,
+                      num_beams: int = 4,
+                      max_new_tokens: int = 32,
+                      length_penalty: float = 1.0,
+                      eos_token_id: Optional[int] = None) -> np.ndarray:
+        """Beam search for a single prompt (B=1).
+
+        KV caches are replicated per beam and reordered after every step
+        with a compiled gather — the analog of the reference's
+        ``get_index_select_mesh_executable`` beam-cache reordering
+        (ref mesh_executable.py:1168 / wrapper.py:20).
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        if input_ids.ndim == 1:
+            input_ids = input_ids[None]
+        assert input_ids.shape[0] == 1, "beam search takes one prompt"
+        s = input_ids.shape[1]
+        assert s + max_new_tokens <= self.config.seq_len
+
+        beams = jnp.repeat(input_ids, num_beams, axis=0)     # (K, S)
+        caches = init_kv_caches(self.config, num_beams)
+        logits, caches = self._prefill(self.params, beams, caches)
+        scores = jnp.where(jnp.arange(num_beams) == 0, 0.0, -1e9)
+        finished = jnp.zeros((num_beams,), bool)
+        # generated length per beam, frozen at its eos
+        gen_len = jnp.zeros((num_beams,), jnp.float32)
+
+        index = s
+        for t in range(max_new_tokens):
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            v = logp.shape[-1]
+            cand = scores[:, None] + jnp.where(
+                finished[:, None], jnp.where(
+                    jnp.arange(v)[None] == (eos_token_id or 0), 0.0, -1e9),
+                logp)                                        # (K, V)
+            flat = cand.reshape(-1)
+            top_scores, top_idx = jax.lax.top_k(flat, num_beams)
+            beam_idx = top_idx // v
+            tok_idx = (top_idx % v).astype(jnp.int32)
+            beams = jnp.take(beams, beam_idx, axis=0)
+            beams = jnp.concatenate([beams, tok_idx[:, None]], axis=1)
+            scores = top_scores
+            finished = jnp.take(finished, beam_idx)
+            gen_len = jnp.take(gen_len, beam_idx)
+            if eos_token_id is not None:
+                newly_done = (~finished) & (tok_idx == eos_token_id)
+                finished = finished | newly_done
+            # unfinished beams grew by one token this step
+            gen_len = jnp.where(finished, gen_len, gen_len + 1.0)
+            last_step = (t == max_new_tokens - 1) or (
+                eos_token_id is not None and bool(finished.all()))
+            if last_step:
+                break
+            caches = self._reorder(caches, beam_idx)
+            logits, caches = self._decode(self.params, tok_idx[:, None],
+                                          index, caches)
+            index += 1
+        # best beam by length-normalized score (per-beam generated length)
+        norm = scores / (jnp.maximum(gen_len, 1.0)**length_penalty)
+        best = int(jnp.argmax(norm))
+        return np.asarray(beams[best:best + 1])
 
 
 def get_model(name_or_config,

@@ -1,0 +1,53 @@
+"""HF GPT-2 weight conversion parity (ref llm_serving weight loading)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from alpa_tpu.model.weight_loading import load_gpt2
+
+
+class TestGPT2Loading:
+
+    def test_logits_match_transformers(self):
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        hf_config = GPT2Config(vocab_size=128, n_positions=32, n_embd=48,
+                               n_layer=2, n_head=4,
+                               attn_pdrop=0.0, resid_pdrop=0.0,
+                               embd_pdrop=0.0)
+        hf_model = GPT2LMHeadModel(hf_config).eval()
+        model, params, config = load_gpt2(hf_model)
+
+        ids = np.random.RandomState(0).randint(0, 128, (2, 16))
+        with torch.no_grad():
+            want = hf_model(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+    def test_sharded_loading(self):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        hf_config = GPT2Config(vocab_size=128, n_positions=32, n_embd=64,
+                               n_layer=1, n_head=4)
+        hf_model = GPT2LMHeadModel(hf_config)
+        mesh = Mesh(np.array(jax.devices()).reshape(8), ("tp",))
+        model, params0, config = load_gpt2(hf_model)
+        shardings = jax.tree_util.tree_map(
+            lambda x: NamedSharding(mesh, P("tp", None))
+            if np.ndim(x) == 2 and x.shape[0] % 8 == 0 else
+            NamedSharding(mesh, P()), params0)
+        model, params, config = load_gpt2(hf_model, shardings=shardings)
+        leaf = params["params"]["wte"]["embedding"]
+        assert leaf.sharding.is_equivalent_to(
+            NamedSharding(mesh, P("tp", None)), 2)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

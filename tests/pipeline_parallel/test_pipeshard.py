@@ -259,3 +259,31 @@ class TestAutoStage:
         from alpa_tpu.pipeline_parallel.stage_dp import _load_native
         assert _load_native() is not None, (
             "C++ stage DP library failed to build/load")
+
+
+class TestTraceDump:
+
+    def test_chrome_trace_dump(self, tmp_path):
+        import json
+
+        from alpa_tpu.global_env import global_config
+        from alpa_tpu.timer import tracer
+
+        tracer.clear()
+        global_config.collect_trace = True
+        try:
+            ex = _compare_pipeshard(
+                PipeshardParallel(num_micro_batches=2,
+                                  layer_option=ManualLayerOption(),
+                                  stage_option=UniformStageOption(
+                                      num_stages=2)),
+                n_steps=1)
+            f = str(tmp_path / "trace.json")
+            ex.dump_stage_execution_trace(f)
+            with open(f, encoding="utf-8") as fh:
+                trace = json.load(fh)
+            names = {e["name"] for e in trace["traceEvents"]}
+            assert "RUN" in names
+        finally:
+            global_config.collect_trace = False
+            tracer.clear()

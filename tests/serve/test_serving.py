@@ -89,3 +89,31 @@ class TestController:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestBeamSearch:
+
+    def test_beam_width_one_equals_greedy(self):
+        gen = _tiny_generator()
+        prompt = np.array([[1, 2, 3]], np.int32)
+        greedy = gen.generate(prompt, GenerationConfig(max_new_tokens=5))
+        beam1 = gen.generate_beam(prompt, num_beams=1, max_new_tokens=5)
+        np.testing.assert_array_equal(greedy, beam1)
+
+    def test_beam_search_finds_higher_likelihood(self):
+        gen = _tiny_generator()
+        prompt = np.array([[1, 2]], np.int32)
+        greedy = gen.generate(prompt, GenerationConfig(max_new_tokens=6))
+        beam = gen.generate_beam(prompt, num_beams=4, max_new_tokens=6)
+
+        def seq_logprob(ids):
+            logits = gen.model.apply(gen.params, jnp.asarray(ids))
+            logp = jax.nn.log_softmax(
+                np.asarray(logits, np.float32), axis=-1)
+            total = 0.0
+            for t in range(1, ids.shape[1]):
+                total += float(logp[0, t - 1, ids[0, t]])
+            return total
+
+        # the beam result's sequence log-prob must be >= greedy's
+        assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4

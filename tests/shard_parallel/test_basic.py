@@ -87,3 +87,28 @@ class TestInference:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestManualSharding:
+
+    def test_manual_in_specs_override_planner(self):
+        from jax.sharding import PartitionSpec as P
+
+        from alpa_tpu import ManualShardingOption, ShardParallel
+        from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                      get_mlp_train_step)
+
+        state, batch = create_mlp_train_state_and_batch(batch_size=64)
+        # force the batch dict's leaves: x sharded on rows, y replicated
+        ms = ManualShardingOption(
+            in_axis_resources=(None, {"x": P("mesh0"), "y": P()}))
+        method = ShardParallel(manual_sharding_option=ms)
+        step = get_mlp_train_step(method, use_value_and_grad=True)
+        s1, _ = step(state, batch)
+        ex = step.get_last_executable()
+        specs = [
+            str(s.spec) for s, a in zip(ex.in_shardings, ex.in_avals)
+            if len(a.shape) == 2 and a.shape[0] == 64
+        ]
+        assert "PartitionSpec('mesh0',)" in specs, specs
+        assert "PartitionSpec()" in specs, specs
