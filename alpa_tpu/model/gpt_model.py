@@ -97,6 +97,9 @@ def get_attention_fn(config: GPTConfig) -> Callable:
     if config.attention_impl == "ring":
         from alpa_tpu.ops.ring_attention import ring_attention
         return partial(ring_attention, axis_name=config.sp_axis)
+    if config.attention_impl == "ulysses":
+        from alpa_tpu.ops.ulysses_attention import ulysses_attention
+        return partial(ulysses_attention, axis_name=config.sp_axis)
     return reference_attention
 
 

@@ -99,11 +99,13 @@ def load_gpt2(model_name_or_model,
     params = convert_gpt2_state_dict(hf_model.state_dict(), config)
     if shardings is not None:
         # leaves stay numpy until device_put with the TARGET sharding —
-        # no full per-device replica ever materializes
+        # no full per-device replica ever materializes.  is_leaf lets None
+        # entries in the shardings tree mean "replicate this leaf".
         params = jax.tree_util.tree_map(
             lambda x, s: jax.device_put(np.asarray(x, dtype), s)
             if s is not None else jnp.asarray(x, dtype),
-            params, shardings)
+            params, shardings,
+            is_leaf=lambda t: t is None)
     else:
         params = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x, dtype), params)

@@ -1,0 +1,84 @@
+"""Ulysses-style sequence parallelism: all-to-all head redistribution.
+
+The second sequence-parallel design named in SURVEY.md §2.7 (alongside
+ring attention): instead of rotating k/v around a ring, an all-to-all
+converts sequence sharding into *head* sharding —
+
+  in : q/k/v sharded over sequence     (B, S/n, H,   D)
+  a2a: -> sharded over heads           (B, S,   H/n, D)
+  attention per head group (full sequence visible locally)
+  a2a: -> back to sequence sharding    (B, S/n, H,   D)
+
+Two all-to-alls per attention instead of (ring-size - 1) permutes; better
+when heads divide evenly by the axis and the sequence is very long (each
+device sees the whole sequence for its heads, so any attention kernel —
+including the pallas flash kernel — applies unchanged per shard).
+"""
+import logging
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+logger = logging.getLogger(__name__)
+
+
+def _seq_to_heads(x, axis_name: str):
+    """(B, S/n, H, D) local -> (B, S, H/n, D) local via tiled all-to-all."""
+    n = lax.axis_size(axis_name)
+    assert x.shape[2] % n == 0, (
+        f"num_heads {x.shape[2]} not divisible by sp axis size {n}")
+    return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)
+
+
+def _heads_to_seq(x, axis_name: str):
+    """(B, S, H/n, D) local -> (B, S/n, H, D) local via tiled all-to-all."""
+    n = lax.axis_size(axis_name)
+    assert x.shape[1] % n == 0
+    return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                      attn_fn=None):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Call inside shard_map manual over ``axis_name``; q/k/v are local
+    sequence shards (B, S_local, H, D).  ``attn_fn`` is any full-sequence
+    attention (default: the einsum reference; pass flash_attention for the
+    pallas kernel).
+    """
+    if attn_fn is None:
+        from alpa_tpu.model.gpt_model import reference_attention
+        attn_fn = partial(reference_attention)
+    q = _seq_to_heads(q, axis_name)
+    k = _seq_to_heads(k, axis_name)
+    v = _seq_to_heads(v, axis_name)
+    o = attn_fn(q, k, v, causal=causal)
+    return _heads_to_seq(o, axis_name)
+
+
+def make_ulysses_attention_fn(mesh, sp_axis: str, attn_fn=None):
+    """Build an attention fn with Ulysses sequence parallelism over
+    ``sp_axis`` (counterpart of ring_attention.make_ring_attention_fn)."""
+    from jax.sharding import PartitionSpec as P
+
+    def attention(q, k, v, *, causal: bool = True, offset: int = 0):
+        del offset
+
+        def inner(q_, k_, v_):
+            return ulysses_attention(q_, k_, v_, axis_name=sp_axis,
+                                     causal=causal, attn_fn=attn_fn)
+
+        sm = jax.shard_map(inner,
+                           mesh=mesh,
+                           in_specs=(P(None, sp_axis), P(None, sp_axis),
+                                     P(None, sp_axis)),
+                           out_specs=P(None, sp_axis),
+                           axis_names={sp_axis},
+                           check_vma=False)
+        return sm(q, k, v)
+
+    return attention

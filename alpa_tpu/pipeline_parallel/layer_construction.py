@@ -55,8 +55,23 @@ class AutoLayerOption(LayerOption):
 
 @dataclasses.dataclass
 class FollowLayerOption(LayerOption):
-    """Reuse another function's clustering (ref layer_construction.py:121)."""
+    """Reuse the layer count decided for another parallelized function
+    (ref layer_construction.py:121): cluster this function automatically
+    into the same number of layers so stage assignments line up."""
+    src_executable: Any = None
     layer_num: int = 2
+
+    def resolved_layer_num(self) -> int:
+        ex = self.src_executable
+        if ex is None:
+            return self.layer_num
+        n = getattr(ex, "num_fwd_stages", None)
+        if n is None:
+            raise ValueError(
+                "FollowLayerOption.src_executable must be a pipeshard "
+                f"executable (got {type(ex).__name__}, which has no "
+                "stages to follow); pass layer_num explicitly instead")
+        return int(n)
 
 
 # ---- active-option context used by alpa_tpu.grad ----
@@ -314,6 +329,9 @@ def layer_level_transform(fn: Callable, layer_option: LayerOption) -> Callable:
             sliced = cluster_eqns_by_cost(closed_jaxpr,
                                           layer_option.layer_num,
                                           layer_option.eps)
+        elif isinstance(layer_option, FollowLayerOption):
+            sliced = cluster_eqns_by_cost(closed_jaxpr,
+                                          layer_option.resolved_layer_num())
         else:
             sliced = slice_eqns_by_boundary(closed_jaxpr)
         marked = add_pipeline_marks_for_sliced_eqns(closed_jaxpr, sliced)

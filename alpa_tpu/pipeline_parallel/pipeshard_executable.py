@@ -38,7 +38,7 @@ class StageExecutable:
     """One compiled stage bound to one mesh."""
 
     def __init__(self, name, comp, mesh_id, physical_mesh, as_option,
-                 logical_shape, donate_idx):
+                 logical_shape, donate_idx, as_overrides=None):
         self.name = name
         self.comp = comp
         self.mesh_id = mesh_id
@@ -55,6 +55,14 @@ class StageExecutable:
             opt = as_option.copy()
             if logical_shape is not None:
                 opt.logical_mesh_shape = tuple(logical_shape)
+            # per-stage AutoShardingOption overrides
+            # (ref submesh_autosharding_option_dicts)
+            for k, v in (as_overrides or {}).items():
+                if not hasattr(opt, k):
+                    raise ValueError(
+                        f"unknown AutoShardingOption field {k!r} in "
+                        "submesh_autosharding_option_dicts")
+                setattr(opt, k, v)
             jax_mesh, in_shardings, cfn, _shape = plan_auto_sharding(
                 fun, avals, [""] * len(avals), [], physical_mesh, opt)
             if cfn is not None:
@@ -145,14 +153,16 @@ class PipeshardDriverExecutable:
             ]
             self.stage_execs.append(
                 StageExecutable(comp.name, comp, s, self.mesh_group[s],
-                                as_option, logical_shapes[s], donate))
+                                as_option, logical_shapes[s], donate,
+                                as_dicts[s] if as_dicts else None))
         for s, comp in enumerate(bwd_stages):
             donate = [
                 i for i, v in enumerate(comp.invars) if v in self.acc_pairs
             ]
             self.stage_execs.append(
                 StageExecutable(comp.name, comp, s, self.mesh_group[s],
-                                as_option, logical_shapes[s], donate))
+                                as_option, logical_shapes[s], donate,
+                                as_dicts[s] if as_dicts else None))
         self.num_fwd_stages = len(fwd_stages)
         self.has_bwd = len(bwd_stages) > 0
         # Donate state inputs (params/opt state) to the apply executables
@@ -571,11 +581,18 @@ class PipeshardDriverExecutable:
     def dump_stage_execution_trace(self, filename: str):
         """Write the collected tracer events as a Chrome trace JSON
         (ref dump_stage_execution_trace_internal,
-        pipeshard_executable.py:592).  Requires
-        global_config.collect_trace=True during execution."""
+        pipeshard_executable.py:592).  Events come from the process-global
+        tracer: run one executable at a time between tracer.clear() calls
+        to attribute events.  Requires global_config.collect_trace=True
+        during execution (warned if the trace is empty)."""
         import json
+        events = tracer.to_chrome_trace()
+        if not events:
+            logger.warning(
+                "dump_stage_execution_trace: no events collected — set "
+                "global_config.collect_trace = True before running")
         with open(filename, "w", encoding="utf-8") as f:
-            json.dump({"traceEvents": tracer.to_chrome_trace()}, f)
+            json.dump({"traceEvents": events}, f)
 
     def get_resharding_report(self) -> str:
         """Planned cross-mesh traffic per step (tile-level accounting from

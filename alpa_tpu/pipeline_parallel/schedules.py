@@ -180,6 +180,13 @@ class InferenceSchedule(PipelineSchedule):
 def create_pipeline_schedule(name: str, *, num_stages: int, num_meshes: int,
                              num_batch: int) -> PipelineSchedule:
     """(ref schedules.py:528)"""
+    if name == "1f1b_overlap_friendly":
+        # The reference reorders sends by producer order so NCCL comm
+        # overlaps compute (ref OverlapFriendlyPipeDreamSchedule:452 +
+        # emitter :1109).  Here dispatch is already fully asynchronous and
+        # XLA/the jax runtime overlap transfers with compute, so the plain
+        # 1F1B tick order is already overlap-friendly.
+        name = "1f1b"
     if name == "gpipe":
         return GpipeSchedule(num_stages=num_stages, num_meshes=num_meshes,
                              num_batch=num_batch)

@@ -128,9 +128,15 @@ class Generator:
         s = input_ids.shape[1]
         assert s + max_new_tokens <= self.config.seq_len
 
+        # Prefill ONCE (B=1), then broadcast logits + caches across the
+        # beam axis — K-times cheaper than prefilling identical copies.
+        caches1 = init_kv_caches(self.config, 1)
+        logits1, caches1 = self._prefill(self.params, input_ids, caches1)
         beams = jnp.repeat(input_ids, num_beams, axis=0)     # (K, S)
-        caches = init_kv_caches(self.config, num_beams)
-        logits, caches = self._prefill(self.params, beams, caches)
+        logits = jnp.repeat(logits1, num_beams, axis=0)
+        caches = jax.tree_util.tree_map(
+            lambda x: jnp.repeat(x, num_beams, axis=0)
+            if hasattr(x, "ndim") and x.ndim > 0 else x, caches1)
         scores = jnp.where(jnp.arange(num_beams) == 0, 0.0, -1e9)
         finished = jnp.zeros((num_beams,), bool)
         # generated length per beam, frozen at its eos
@@ -153,11 +159,11 @@ class Generator:
             scores = top_scores
             finished = jnp.take(finished, beam_idx)
             gen_len = jnp.take(gen_len, beam_idx)
-            if eos_token_id is not None:
-                newly_done = (~finished) & (tok_idx == eos_token_id)
-                finished = finished | newly_done
-            # unfinished beams grew by one token this step
+            # beams running at the START of this step count this token
+            # (including an EOS, matching the standard length convention)
             gen_len = jnp.where(finished, gen_len, gen_len + 1.0)
+            if eos_token_id is not None:
+                finished = finished | (tok_idx == eos_token_id)
             last_step = (t == max_new_tokens - 1) or (
                 eos_token_id is not None and bool(finished.all()))
             if last_step:

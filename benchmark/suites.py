@@ -54,6 +54,13 @@ suites = {
     "gpt.perf_test_auto": [
         _gpt("gpt-125M-auto", "125M", 16, nmb=2, method="pipeshard"),
     ],
+    # long-context: flash attention's advantage grows with sequence length
+    "gpt.longseq": [
+        _gpt("gpt-125M-s4k-ref", "125M", 1, seq=4096,
+             attention_impl="reference"),
+        _gpt("gpt-125M-s4k-flash", "125M", 1, seq=4096,
+             attention_impl="flash"),
+    ],
     "gpt.ladder": [
         _gpt(f"gpt-{k}-bs8", k, 8) for k in ("125M", "350M")
     ],
