@@ -8,14 +8,17 @@ import os
 # Must be set before the first backend use: force an 8-device virtual CPU
 # mesh.  (The axon sitecustomize may have imported jax already and pinned
 # jax_platforms, so we also override via jax.config below.)
+# Set ALPA_TPU_TEST_ON_TPU=1 to keep the real backend (for tests/tpu/).
+_on_tpu = os.environ.get("ALPA_TPU_TEST_ON_TPU") == "1"
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if not _on_tpu and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags +
                                " --xla_force_host_platform_device_count=8")
-os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if not _on_tpu:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
 os.environ["ALPA_TPU_TESTING"] = "1"
 
 import pytest  # noqa: E402

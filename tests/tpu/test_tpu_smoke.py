@@ -3,7 +3,7 @@
 Runs only on a real TPU backend (skipped on the CPU mesh the main suite
 uses):
 
-  JAX_PLATFORMS= python -m pytest tests/tpu/ -q      # on TPU hosts
+  ALPA_TPU_TEST_ON_TPU=1 python -m pytest tests/tpu/ -q   # on TPU hosts
 
 Unlike the reference — whose TPU support was intra-op-only and partial
 (ref shard_parallel/compile_executable.py:83-85 raising NotImplementedError
