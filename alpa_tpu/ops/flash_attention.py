@@ -1,12 +1,18 @@
-"""Flash attention forward kernel in pallas (TPU), with recompute backward.
+"""Flash attention forward kernels in pallas (TPU), with recompute backward.
 
-Blocked online-softmax attention: the q-block stays in VMEM, k/v stream
-block by block, and the softmax normalizer is maintained incrementally —
-the S x S score matrix never materializes in HBM.  Grid: (batch*heads,
-q blocks); k/v for one (batch, head) are VMEM-resident (fine for the
-moderate per-chip sequence lengths this kernel targets; longer sequences
-are handled by sharding the sequence with ring attention, which calls this
-kernel per block).
+Blocked online-softmax attention: the q-block stays in VMEM, the softmax
+normalizer is maintained incrementally, and the S x S score matrix never
+materializes in HBM.  Two forward paths, picked by k/v size:
+
+* **resident** (short sequences): k/v for one (batch, head) live in VMEM;
+  grid (B*H, q blocks) with a fori_loop over k blocks and causal
+  early-exit.
+* **streaming** (k/v > ~4MB): grid (B*H, q blocks, k blocks) — k/v blocks
+  stream from HBM via BlockSpec index maps, the (m, l, acc) state persists
+  in VMEM scratch across the sequential innermost grid dim, and causal
+  blocks above the diagonal are skipped with ``pl.when``.  Per-chip
+  sequence length is then HBM-bound, and ring attention shards beyond
+  that.
 
 Backward: ``jax.custom_vjp`` recomputes attention with the einsum reference
 implementation and differentiates that — the standard remat-style tradeoff
@@ -20,8 +26,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e9
+
+
+def _online_softmax_update(q, k_blk, v_blk, m_prev, l_prev, acc, *,
+                           causal: bool, q_start, k_start):
+    """One flash-attention block update, shared by both kernels:
+    (m, l, acc) -> (m', l', acc') after attending q to one k/v block."""
+    block_q = q.shape[0]
+    block_k = k_blk.shape[0]
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    if causal:
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 0)
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32,
+                                               (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m_cur = jnp.max(s, axis=1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        p, v_blk, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, acc_new
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
@@ -48,23 +80,9 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         k_start = kb * block_k
         k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
         v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        if causal:
-            q_pos = q_start + lax.broadcasted_iota(jnp.int32,
-                                                   (block_q, block_k), 0)
-            k_pos = k_start + lax.broadcasted_iota(jnp.int32,
-                                                   (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_cur = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc
+        return _online_softmax_update(q, k_blk, v_blk, m_prev, l_prev,
+                                      acc, causal=causal, q_start=q_start,
+                                      k_start=k_start)
 
     if causal:
         # skip fully-masked k blocks beyond the diagonal
@@ -77,6 +95,60 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
 
 
+# above this many k/v bytes per (batch, head), stream blocks from HBM
+# instead of keeping k/v VMEM-resident (VMEM is ~16MB/core)
+VMEM_RESIDENT_LIMIT = 4 * 1024 * 1024
+
+
+def _flash_streaming_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                            acc_ref, *, causal: bool, sm_scale: float,
+                            q_offset: int, nk: int, block_q: int,
+                            block_k: int):
+    """Grid (B*H, q blocks, k blocks): k/v blocks stream from HBM; the
+    online-softmax state (m, l, acc) lives in VMEM scratch that persists
+    across the sequential innermost grid dim."""
+    kb = pl.program_id(2)
+    qb = pl.program_id(1)
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q_start = qb * block_q + q_offset
+    k_start = kb * block_k
+    # blocks entirely above the diagonal contribute nothing (their DMA is
+    # also suppressed by the clamped k index map in _flash_forward)
+    run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[:].astype(jnp.float32) * sm_scale
+        k_blk = k_ref[:].astype(jnp.float32)
+        v_blk = v_ref[:].astype(jnp.float32)
+        m_new, l_new, acc_new = _online_softmax_update(
+            q, k_blk, v_blk, m_ref[:], l_ref[:], acc_ref[:],
+            causal=causal, q_start=q_start, k_start=k_start)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+        acc_ref[:] = acc_new
+
+    @pl.when(kb == nk - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:], 1e-20)
+        o_ref[:] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(size: int, target: int) -> int:
+    """Largest divisor of ``size`` not exceeding ``target`` — blocks must
+    tile the sequence exactly (no partial-block masking implemented)."""
+    b = min(target, size)
+    while size % b != 0:
+        b -= 1
+    return b
+
+
 def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
                    block_q: int = 256, block_k: int = 256,
                    interpret: bool = None):
@@ -84,8 +156,8 @@ def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
     b, sq, h, d = q.shape
     sk = k.shape[1]
     sm_scale = 1.0 / np.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
 
@@ -93,6 +165,47 @@ def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    kv_bytes = 2 * sk * d * k.dtype.itemsize
+    if kv_bytes > VMEM_RESIDENT_LIMIT:
+        # long-sequence path: stream k/v blocks, carry softmax state in
+        # scratch across the innermost (sequential) grid dim
+        nk = sk // block_k
+        grid = (b * h, sq // block_q, nk)
+        if causal:
+            # clamp the k index for fully-masked blocks to the last needed
+            # block: pl.when skips their compute, and the clamp means no
+            # fresh DMA is issued for them either (the previous block's
+            # buffer is reused) — saves ~half the k/v HBM traffic
+            def kv_index(i, j, kb):
+                last_needed = (j * block_q + block_q - 1 + q_offset) \
+                    // block_k
+                return (i, jnp.minimum(kb, last_needed), 0)
+        else:
+            def kv_index(i, j, kb):
+                return (i, kb, 0)
+        out = pl.pallas_call(
+            partial(_flash_streaming_kernel, causal=causal,
+                    sm_scale=sm_scale, q_offset=q_offset, nk=nk,
+                    block_q=block_q, block_k=block_k),
+            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, block_q, d),
+                             lambda i, j, kb: (i, j, 0)),
+                pl.BlockSpec((None, block_k, d), kv_index),
+                pl.BlockSpec((None, block_k, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec((None, block_q, d),
+                                   lambda i, j, kb: (i, j, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_q,), jnp.float32),
+                pltpu.VMEM((block_q,), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qt, kt, vt)
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
     grid = (b * h, pl.cdiv(sq, block_q))
     out = pl.pallas_call(
@@ -121,12 +234,32 @@ def _flash_fwd_rule(q, k, v, causal, q_offset):
     return out, (q, k, v)
 
 
-def _flash_bwd_rule(causal, q_offset, res, do):
+def _chunked_reference_attention(q, k, v, *, causal: bool, offset: int,
+                                 chunk: int = 512):
+    """Reference attention computed q-chunk-wise with lax.map: peak score
+    memory is chunk x S instead of S x S, so the recompute backward stays
+    feasible at the long sequence lengths the streaming forward unlocks."""
     from alpa_tpu.model.gpt_model import reference_attention
+    b, s, h, d = q.shape
+    if s % chunk != 0 or s <= chunk:
+        return reference_attention(q, k, v, causal=causal, offset=offset)
+    n = s // chunk
+    qc = q.reshape(b, n, chunk, h, d).transpose(1, 0, 2, 3, 4)
+
+    def one_chunk(args):
+        i, q_i = args
+        return reference_attention(q_i, k, v, causal=causal,
+                                   offset=offset + i * chunk)
+
+    outs = jax.lax.map(one_chunk, (jnp.arange(n), qc))
+    return outs.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
+
+
+def _flash_bwd_rule(causal, q_offset, res, do):
     q, k, v = res
     _, vjp = jax.vjp(
-        lambda q_, k_, v_: reference_attention(q_, k_, v_, causal=causal,
-                                               offset=q_offset), q, k, v)
+        lambda q_, k_, v_: _chunked_reference_attention(
+            q_, k_, v_, causal=causal, offset=q_offset), q, k, v)
     return vjp(do)
 
 

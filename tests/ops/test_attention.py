@@ -136,3 +136,20 @@ class TestUlyssesAttention:
         with pytest.raises(Exception, match="divisible|not divisible"):
             with jax.set_mesh(mesh):
                 jax.jit(lambda q, k, v: attn(q, k, v))(q, k, v)
+
+
+class TestStreamingFlash:
+
+    def test_long_sequence_streaming_path(self):
+        """k/v beyond the VMEM-resident limit take the HBM-streaming
+        kernel; result must match the reference exactly."""
+        from alpa_tpu.ops.flash_attention import (VMEM_RESIDENT_LIMIT,
+                                                  flash_attention)
+        s, d = 16384, 64
+        assert 2 * s * d * 4 > VMEM_RESIDENT_LIMIT  # streaming triggers
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (1, s, 1, d)) * 0.5 for kk in ks)
+        out = flash_attention(q, k, v, causal=True)
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
