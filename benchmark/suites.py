@@ -42,6 +42,13 @@ def _gpt(name, spec_name, bs, nmb=1, method="shard", seq=1024,
 
 
 suites = {
+    # CPU-runnable smoke of the driver itself
+    "gpt.micro": [
+        BenchmarkCase("gpt-micro", "gpt",
+                      dict(hidden_size=64, num_layers=2, num_heads=4,
+                           seq_len=64, vocab_size=256),
+                      batch_size=8, dtype="float32"),
+    ],
     # quick single-chip perf check (the bench.py default case)
     "gpt.tiny": [
         _gpt("gpt-125M-bs8", "125M", 8),
