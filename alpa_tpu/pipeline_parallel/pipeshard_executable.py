@@ -35,7 +35,14 @@ logger = logging.getLogger(__name__)
 
 
 class StageExecutable:
-    """One compiled stage bound to one mesh."""
+    """One compiled stage bound to one mesh.
+
+    Two-phase: ``plan()`` runs the intra-op planner and exposes
+    ``in_shardings``; the driver may then *unify* shardings of values
+    shared across same-mesh stages (see ``_unify_same_mesh_shardings``)
+    before ``compile()`` locks them in — eliminating runtime relayouts
+    between stages on one mesh.
+    """
 
     def __init__(self, name, comp, mesh_id, physical_mesh, as_option,
                  logical_shape, donate_idx, as_overrides=None):
@@ -45,19 +52,29 @@ class StageExecutable:
         self.invars = list(comp.invars)
         self.outvars = list(comp.outvars)
         self.donate_idx = tuple(donate_idx)
+        self._physical_mesh = physical_mesh
+        self._as_option = as_option
+        self._logical_shape = logical_shape
+        self._as_overrides = as_overrides
+        self._fun = None
+        self.compiled = None
+        self.plan()
 
-        closed = comp.closed_jaxpr()
+    def plan(self):
+        closed = self.comp.closed_jaxpr()
         fun = jaxpr_as_fun(closed)
-        avals = [v.aval for v in comp.invars]
+        avals = [v.aval for v in self.comp.invars]
+        physical_mesh = self._physical_mesh
+        as_option = self._as_option
 
         if physical_mesh.num_devices > 1 and as_option.enable_auto_sharding:
             from alpa_tpu.shard_parallel.solver import plan_auto_sharding
             opt = as_option.copy()
-            if logical_shape is not None:
-                opt.logical_mesh_shape = tuple(logical_shape)
+            if self._logical_shape is not None:
+                opt.logical_mesh_shape = tuple(self._logical_shape)
             # per-stage AutoShardingOption overrides
             # (ref submesh_autosharding_option_dicts)
-            for k, v in (as_overrides or {}).items():
+            for k, v in (self._as_overrides or {}).items():
                 if not hasattr(opt, k):
                     raise ValueError(
                         f"unknown AutoShardingOption field {k!r} in "
@@ -75,26 +92,45 @@ class StageExecutable:
             in_shardings = [
                 NamedSharding(jax_mesh, PartitionSpec()) for _ in avals
             ]
+        self._fun = fun
+        self._avals = avals
         self.jax_mesh = jax_mesh
         self.in_shardings = list(in_shardings)
+        # consumer-pinned output shardings (filled by unification)
+        self.pinned_out: Dict[Var, Any] = {}
 
+    def donated_out_shardings(self) -> Dict[Var, Any]:
+        """Outvars whose sharding is locked by donation: summed gradient
+        accumulators alias their (donated) acc invar's buffer, so their
+        output sharding must equal that input sharding.  Single source of
+        truth for both unification seeding and compile()."""
+        donate_var = {self.comp.invars[i]: i for i in self.donate_idx}
+        acc_out_for = getattr(self.comp, "_acc_out_map", {})
+        return {
+            ov: self.in_shardings[donate_var[acc_out_for[ov]]]
+            for ov in self.comp.outvars
+            if ov in acc_out_for and acc_out_for[ov] in donate_var
+        }
+
+    def compile(self):
+        comp = self.comp
         # donated (accumulator) outputs must keep the input sharding
+        locked = self.donated_out_shardings()
         out_shardings = []
-        donate_var = {comp.invars[i]: i for i in donate_idx}
-        # map summed outvars to their acc invar sharding where possible
-        acc_out_for = getattr(comp, "_acc_out_map", {})
         for ov in comp.outvars:
-            if ov in acc_out_for and acc_out_for[ov] in donate_var:
-                out_shardings.append(
-                    in_shardings[donate_var[acc_out_for[ov]]])
+            if ov in locked:
+                out_shardings.append(locked[ov])
+            elif ov in self.pinned_out:
+                out_shardings.append(self.pinned_out[ov])
             else:
                 out_shardings.append(None)
+        in_shardings = self.in_shardings
 
-        jitted = jax.jit(fun,
+        jitted = jax.jit(self._fun,
                          in_shardings=tuple(in_shardings),
                          out_shardings=out_shardings,
                          donate_argnums=self.donate_idx)
-        lowered = jitted.lower(*avals)
+        lowered = jitted.lower(*self._avals)
         self.compiled = lowered.compile()
         self.out_shardings = list(self.compiled.output_shardings)
 
@@ -103,6 +139,38 @@ class StageExecutable:
 
     def __call__(self, args):
         return self.compiled(*args)
+
+
+def _unify_same_mesh_shardings(execs: List["StageExecutable"]):
+    """Align shardings of values shared between stages on one mesh:
+
+    * multiple consumers of the same var on a mesh adopt the first
+      consumer's planned sharding,
+    * producers pin their output sharding of a var to its same-mesh
+      consumer's input sharding,
+
+    so no runtime relayout (same-mesh device_put) is needed between
+    stages.  Call after every stage's plan() and before any compile().
+    """
+    # (mesh_id, var) -> chosen sharding (first consumer wins)
+    chosen: Dict[Tuple[int, Var], Any] = {}
+    # accumulator sum outputs are donation-locked to the acc input's
+    # sharding — seed those first so consumers (apply stages) adopt them
+    for ex in execs:
+        for ov, s in ex.donated_out_shardings().items():
+            chosen[(ex.mesh_id, ov)] = s
+    for ex in execs:
+        for pos, v in enumerate(ex.invars):
+            key = (ex.mesh_id, v)
+            if key in chosen:
+                ex.in_shardings[pos] = chosen[key]
+            else:
+                chosen[key] = ex.in_shardings[pos]
+    for ex in execs:
+        for v in ex.outvars:
+            s = chosen.get((ex.mesh_id, v))
+            if s is not None:
+                ex.pinned_out[v] = s
 
 
 class PipeshardDriverExecutable:
@@ -187,6 +255,14 @@ class PipeshardDriverExecutable:
                                     as_option, logical_shapes[m], donate))
             else:
                 self.apply_execs.append(None)
+        # unify shardings of values shared across same-mesh stages, then
+        # compile everything with the agreed layouts
+        all_execs = self.stage_execs + [
+            e for e in self.apply_execs if e is not None
+        ]
+        _unify_same_mesh_shardings(all_execs)
+        for e in all_execs:
+            e.compile()
         if global_config.print_compilation_time:
             logger.warning("stage compilation took %.2f s",
                            time.time() - tic)

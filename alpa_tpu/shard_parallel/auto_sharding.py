@@ -58,6 +58,10 @@ class AutoShardingOption:
     # Insert with_sharding_constraint on solved dot outputs so GSPMD
     # follows the ILP exactly (auto-disabled when remat is present).
     emit_sharding_constraints: bool = True
+    # Outputs smaller than this many elements are left to propagation
+    # (pinning tiny tensors can force costly GSPMD transitions).  Set 0 to
+    # constrain everything.
+    constrain_min_elements: int = 1 << 16
     mesh_shape_search: bool = False
 
     def copy(self):

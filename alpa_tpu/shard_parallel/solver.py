@@ -133,8 +133,8 @@ def plan_auto_sharding(fun: Callable,
     constraint_fn = None
     if option.emit_sharding_constraints and not graph.has_remat:
         from alpa_tpu.shard_parallel.strategy import make_constrained_fun
-        constraint_fn = make_constrained_fun(graph, choice, jax_mesh,
-                                             axis_names,
-                                             closed_jaxpr.consts)
+        constraint_fn = make_constrained_fun(
+            graph, choice, jax_mesh, axis_names, closed_jaxpr.consts,
+            min_elements=option.constrain_min_elements)
 
     return jax_mesh, in_shardings, constraint_fn, shape

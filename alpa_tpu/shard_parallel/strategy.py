@@ -736,7 +736,7 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
 
 
 def make_constrained_fun(graph: StrategyGraph, choice, jax_mesh,
-                         axis_names, consts):
+                         axis_names, consts, min_elements: int = 1 << 16):
     """Build a function that re-evaluates the (flattened) jaxpr inserting
     ``with_sharding_constraint`` on every solved dot output — so GSPMD
     realizes exactly the ILP's intra-op plan instead of relying on
@@ -751,10 +751,18 @@ def make_constrained_fun(graph: StrategyGraph, choice, jax_mesh,
     from alpa_tpu.shard_parallel.sharding_spec import (is_replicated,
                                                        spec_to_partition_spec)
 
-    # dot outvar -> NamedSharding of the chosen strategy
+    # dot outvar -> NamedSharding of the chosen strategy.  Tensors below
+    # ``min_elements`` (AutoShardingOption.constrain_min_elements) are left
+    # to propagation: pinning them can force GSPMD into "involuntary full
+    # rematerialization" transitions that cost more than the constraint is
+    # worth.
     constraints = {}
     for node, s in zip(graph.nodes, choice):
         if node.kind == "op" and node.outvar is not None:
+            aval = node.outvar.aval
+            if (min_elements and getattr(aval, "shape", None) and
+                    int(np.prod(aval.shape)) < min_elements):
+                continue
             spec = node.strategies[s].out_spec
             if not is_replicated(spec):
                 from jax.sharding import NamedSharding
