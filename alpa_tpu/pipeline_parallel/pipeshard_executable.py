@@ -141,7 +141,8 @@ class StageExecutable:
         return self.compiled(*args)
 
 
-def _unify_same_mesh_shardings(execs: List["StageExecutable"]):
+def _unify_same_mesh_shardings(execs: List["StageExecutable"],
+                               var_alias: Optional[Dict[Var, Var]] = None):
     """Align shardings of values shared between stages on one mesh:
 
     * multiple consumers of the same var on a mesh adopt the first
@@ -152,24 +153,32 @@ def _unify_same_mesh_shardings(execs: List["StageExecutable"]):
     so no runtime relayout (same-mesh device_put) is needed between
     stages.  Call after every stage's plan() and before any compile().
     """
-    # (mesh_id, var) -> chosen sharding (first consumer wins)
+    # (mesh_id, var) -> chosen sharding (first consumer wins).
+    # ``var_alias`` canonicalizes distinct Vars naming the same runtime
+    # value (gradient-marker `post` vars alias the accumulator's summed
+    # outvar), so apply stages adopt the accumulator shardings.
+    var_alias = var_alias or {}
+
+    def canon(v):
+        return var_alias.get(v, v)
+
     chosen: Dict[Tuple[int, Var], Any] = {}
     # accumulator sum outputs are donation-locked to the acc input's
     # sharding — seed those first so consumers (apply stages) adopt them
     for ex in execs:
         for ov, s in ex.donated_out_shardings().items():
-            chosen[(ex.mesh_id, ov)] = s
+            chosen[(ex.mesh_id, canon(ov))] = s
     for ex in execs:
         for pos, v in enumerate(ex.invars):
-            key = (ex.mesh_id, v)
+            key = (ex.mesh_id, canon(v))
             if key in chosen:
                 ex.in_shardings[pos] = chosen[key]
             else:
                 chosen[key] = ex.in_shardings[pos]
     for ex in execs:
         for v in ex.outvars:
-            s = chosen.get((ex.mesh_id, v))
-            if s is not None:
+            s = chosen.get((ex.mesh_id, canon(v)))
+            if s is not None and v not in ex.donated_out_shardings():
                 ex.pinned_out[v] = s
 
 
@@ -260,7 +269,11 @@ class PipeshardDriverExecutable:
         all_execs = self.stage_execs + [
             e for e in self.apply_execs if e is not None
         ]
-        _unify_same_mesh_shardings(all_execs)
+        post_to_sum = {
+            post: acc_info[pre][1]
+            for pre, post in grad_pairs if pre in acc_info
+        }
+        _unify_same_mesh_shardings(all_execs, post_to_sum)
         for e in all_execs:
             e.compile()
         if global_config.print_compilation_time:

@@ -43,7 +43,8 @@ logger = logging.getLogger(__name__)
 
 # Ops followed through without creating a decision node.
 ELEMENTWISE_PRIMS = frozenset({
-    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "atan2",
+    "add", "add_any", "sub", "mul", "div", "max", "min", "pow", "rem",
+    "atan2",
     "and", "or", "xor", "shift_left", "shift_right_logical",
     "shift_right_arithmetic", "nextafter",
     "exp", "log", "log1p", "expm1", "tanh", "sin", "cos", "tan", "asin",
@@ -357,6 +358,91 @@ def enumerate_dot_strategies(eqn, logical_mesh) -> List[Strategy]:
     return strategies
 
 
+def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
+    """Conv handler (analog of the reference dot/conv strategy vectors):
+    each non-trivial mesh axis takes one role —
+
+      'b': shard the batch dim (lhs batch <-> out batch),
+      'o': shard output channels (rhs O <-> out feature),
+      'i': shard input channels (lhs C + rhs I contracted -> all-reduce).
+
+    Spatial sharding (halo exchange) is not enumerated.
+    """
+    mesh_shape = logical_mesh.shape
+    dn = eqn.params["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec_dims = (dn.lhs_spec, dn.rhs_spec,
+                                         dn.out_spec)
+    lhs_av, rhs_av = eqn.invars[0].aval, eqn.invars[1].aval
+    out_av = eqn.outvars[0].aval
+    feature_group_count = eqn.params.get("feature_group_count", 1)
+    lhs_b, lhs_c = lhs_spec[0], lhs_spec[1]
+    rhs_o, rhs_i = rhs_spec[0], rhs_spec[1]
+    out_b, out_f = out_spec_dims[0], out_spec_dims[1]
+
+    nontrivial = [a for a, s in enumerate(mesh_shape) if s > 1]
+    if not nontrivial:
+        return [Strategy("R", replicated_spec(len(out_av.shape)), 0.0,
+                         (replicated_spec(len(lhs_av.shape)),
+                          replicated_spec(len(rhs_av.shape))))]
+
+    roles = ["b", "o"]
+    # contracting input channels is only valid without feature groups
+    if feature_group_count == 1:
+        roles.append("i")
+
+    # Like the dot handler: every non-trivial axis must take a role —
+    # the strategy space has no fully-replicated entry (with no compute
+    # cost in the model, replication would otherwise always win).
+    strategies = []
+    seen = set()
+    for assignment in itertools.product(roles, repeat=len(nontrivial)):
+        lhs_map, rhs_map, out_map = {}, {}, {}
+        ar_axes = []
+        for axis, role in zip(nontrivial, assignment):
+            if role == "b":
+                if lhs_b in lhs_map:
+                    break
+                lhs_map[lhs_b] = axis
+                out_map[out_b] = axis
+            elif role == "o":
+                if rhs_o in rhs_map:
+                    break
+                rhs_map[rhs_o] = axis
+                out_map[out_f] = axis
+            elif role == "i":
+                if lhs_c in lhs_map or rhs_i in rhs_map:
+                    break
+                lhs_map[lhs_c] = axis
+                rhs_map[rhs_i] = axis
+                ar_axes.append(axis)
+        else:
+            lhs_s = make_spec(len(lhs_av.shape), lhs_map)
+            rhs_s = make_spec(len(rhs_av.shape), rhs_map)
+            out_s = make_spec(len(out_av.shape), out_map)
+            if not (spec_valid(lhs_av, lhs_s, mesh_shape) and
+                    spec_valid(rhs_av, rhs_s, mesh_shape) and
+                    spec_valid(out_av, out_s, mesh_shape)):
+                continue
+            key = (lhs_s, rhs_s, out_s)
+            if key in seen:
+                continue
+            seen.add(key)
+            out_bytes = (float(np.prod(out_av.shape)) *
+                         out_av.dtype.itemsize /
+                         num_shards(out_s, mesh_shape))
+            cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
+                       for a in ar_axes)
+            strategies.append(
+                Strategy("conv" + str(assignment), out_s, cost,
+                         (lhs_s, rhs_s)))
+    if not strategies:
+        strategies.append(
+            Strategy("R", replicated_spec(len(out_av.shape)), 0.0,
+                     (replicated_spec(len(lhs_av.shape)),
+                      replicated_spec(len(rhs_av.shape)))))
+    return strategies
+
+
 def enumerate_reduce_strategies(eqn, logical_mesh) -> List[Strategy]:
     """reduce_sum/reduce_max/...: strategies indexed by the operand spec;
     sharded reduced dims pay an all-reduce on the output."""
@@ -445,12 +531,22 @@ def follow_dimmap(eqn, operand_idx: int) -> Optional[DimMap]:
         # mappable iff the >1-sized dims correspond 1:1 in order
         in_nt = [(d, s) for d, s in enumerate(in_shape) if s > 1]
         out_nt = [(d, s) for d, s in enumerate(out_shape) if s > 1]
-        if [s for _, s in in_nt] != [s for _, s in out_nt]:
-            return None
+        if [s for _, s in in_nt] == [s for _, s in out_nt]:
+            dm = [None] * len(out_shape)
+            for (od, _), (id_, _) in zip(out_nt, in_nt):
+                dm[od] = id_
+            return tuple(dm)
+        # partial: a preserved leading-dim prefix keeps its sharding
+        # (covers dim-split/merge tails like GroupNorm's
+        # (N,H,W,C) <-> (N,H,W,G,C/G))
         dm = [None] * len(out_shape)
-        for (od, _), (id_, _) in zip(out_nt, in_nt):
-            dm[od] = id_
-        return tuple(dm)
+        for d in range(min(len(in_shape), len(out_shape))):
+            if in_shape[d] != out_shape[d]:
+                break
+            dm[d] = d
+        if any(x is not None for x in dm):
+            return tuple(dm)
+        return None
     if prim in ("squeeze",):
         dims = set(eqn.params["dimensions"])
         kept = [d for d in range(len(in_shape)) if d not in dims]
@@ -469,6 +565,19 @@ def follow_dimmap(eqn, operand_idx: int) -> Optional[DimMap]:
     if prim in ("rev", "cumsum", "cumprod", "cummax", "cummin",
                 "sort", "argsort"):
         if in_shape == out_shape:
+            return identity_dimmap(len(out_shape))
+        return None
+    if prim in ("reduce_window_max", "reduce_window_min",
+                "reduce_window_sum", "reduce_window", "select_and_scatter",
+                "select_and_scatter_add"):
+        # windowed ops keep dim correspondence (spatial sizes shrink but
+        # batch/feature shardings carry through; spatial sharding costs
+        # are approximated — execution correctness is GSPMD's job)
+        if len(in_shape) == len(out_shape):
+            return identity_dimmap(len(out_shape))
+        return None
+    if prim in ("pad", "slice", "dynamic_slice"):
+        if len(in_shape) == len(out_shape):
             return identity_dimmap(len(out_shape))
         return None
     return None
@@ -586,10 +695,14 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                     var_node[ov] = src
             continue
 
-        if prim == "dot_general":
-            strategies = enumerate_dot_strategies(eqn, logical_mesh)
+        if prim in ("dot_general", "conv_general_dilated"):
+            if prim == "dot_general":
+                strategies = enumerate_dot_strategies(eqn, logical_mesh)
+            else:
+                strategies = enumerate_conv_strategies(eqn, logical_mesh)
             out_av = eqn.outvars[0].aval
-            n = new_node("op", out_av, strategies, f"dot:{out_av.shape}",
+            n = new_node("op", out_av, strategies,
+                         f"{prim.split('_')[0]}:{out_av.shape}",
                          outvar=eqn.outvars[0])
             for oi in range(2):
                 v = eqn.invars[oi]

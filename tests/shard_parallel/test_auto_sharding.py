@@ -171,3 +171,47 @@ class TestConstraintEmission:
                        if str(s.spec) != "PartitionSpec()")
 
         assert count_nonreplicated(200_000) > count_nonreplicated(None)
+
+    def test_wresnet_conv_planner_chooses_parallelism(self):
+        """Convolutions get real strategies (batch/channel roles), not
+        replication barriers: the planner must shard the image batch."""
+        import optax
+        from flax.training import train_state
+
+        from alpa_tpu.model.wide_resnet import WResNetConfig, WideResNet
+
+        cfg = WResNetConfig(num_layers=50, width_factor=1, num_classes=10)
+        model = WideResNet(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (16, 32, 32, 3))
+        y = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 10)
+        state = train_state.TrainState.create(apply_fn=model.apply,
+                                              params=model.init(rng, x),
+                                              tx=optax.sgd(1e-2))
+
+        def step_fn(state, batch):
+
+            def loss_fn(p):
+                logits = state.apply_fn(p, batch["x"])
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, batch["y"]).mean()
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        pstep = alpa_tpu.parallelize(step_fn, method=ShardParallel())
+        serial = jax.jit(step_fn)
+        _, lp = pstep(state, {"x": x, "y": y})
+
+        state2 = train_state.TrainState.create(apply_fn=model.apply,
+                                               params=model.init(rng, x),
+                                               tx=optax.sgd(1e-2))
+        _, ls = serial(state2, {"x": x, "y": y})
+        assert_allclose(float(lp), float(ls), 1e-3, 1e-3)
+        ex = pstep.get_last_executable()
+        x_specs = [
+            s.spec for s, a in zip(ex.in_shardings, ex.in_avals)
+            if a.shape[:1] == (16,) and len(a.shape) == 4
+        ]
+        assert any(any(p is not None for p in spec)
+                   for spec in x_specs), x_specs
