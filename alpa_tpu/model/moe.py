@@ -1,25 +1,29 @@
 """Mixture-of-Experts transformer (GShard-style top-2 gating).
 
 Analog of ref ``alpa/model/moe.py`` (einsum-formulated top-2 gating,
-ref :151-184): the expert dimension is expressed as a leading einsum dim so
-sharding it over a mesh axis makes GSPMD insert the dispatch/combine
-all-to-alls (the reference reaches the same end through its ILP
-``allow_all_to_all`` strategies, SURVEY.md §2.7 EP row).
-
-Expert parallelism here is spelled with an explicit
-``with_sharding_constraint`` on the expert dim (``ep_axis``) so the
-all-to-all placement is deterministic rather than propagation-dependent.
+ref :151-184): the expert dimension is a leading einsum dim, and expert
+parallelism (``ep_axis``) dispatches tokens with EXPLICIT all-to-alls in a
+``shard_map`` over the expert axis — the GShard exchange pattern the
+reference obtains through its ILP ``allow_all_to_all`` strategies
+(SURVEY.md §2.7 EP row).  Spelling the exchange manually (rather than a
+``with_sharding_constraint`` on the expert dim) matters: GSPMD lowers the
+constraint form with all-gathers, roughly n_experts/2 x the bytes of the
+all-to-all.
 """
 import dataclasses
 from typing import Any, Optional
+
+import functools
+import logging
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec
 
 from alpa_tpu.model.gpt_model import GPTConfig, SelfAttention
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +97,55 @@ def top2_gating(logits: jnp.ndarray, capacity: int):
     return combine, dispatch, aux_loss
 
 
+@functools.lru_cache(maxsize=64)
+def _dispatch_fn(mesh, ep_axis: str):
+    """Jitted GShard dispatch, cached per (mesh, axis) so repeated/eager
+    calls (e.g. several MoE layers during flax init) share one
+    compilation.  The jit wrapper also works around partial-manual
+    shard_map rejecting eager execution over an abstract mesh."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    def inner(tok, disp, comb, wi_l, wo_l):
+        # tok: (G/n, S, H); disp/comb: (G/n, S, E, C);
+        # wi_l/wo_l: (E/n, ...) local expert slices
+        expert_in = jnp.einsum("gsec,gsh->egch", disp, tok)
+        # exchange: every device keeps its E/n experts for ALL groups
+        expert_in = lax.all_to_all(expert_in, ep_axis, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        hmid = jnp.einsum("egch,ehm->egcm", expert_in, wi_l)
+        hmid = nn.gelu(hmid, approximate=True)
+        expert_out = jnp.einsum("egcm,emh->egch", hmid, wo_l)
+        expert_out = lax.all_to_all(expert_out, ep_axis, split_axis=1,
+                                    concat_axis=0, tiled=True)
+        return jnp.einsum("egch,gsec->gsh", expert_out, comb)
+
+    sm = jax.shard_map(inner,
+                       mesh=mesh,
+                       in_specs=(P(ep_axis), P(ep_axis), P(ep_axis),
+                                 P(ep_axis), P(ep_axis)),
+                       out_specs=P(ep_axis),
+                       axis_names={ep_axis},
+                       check_vma=False)
+    return jax.jit(sm)
+
+
+def _shard_map_expert_dispatch(tokens, dispatch, combine, wi, wo,
+                               ep_axis: str):
+    """The GShard dispatch as explicit all-to-alls over ``ep_axis``
+    (ref §2.7 EP: 'expert dim sharded => all-to-all inserted by GSPMD' —
+    GSPMD actually lowers the constraint form as all-gathers, so we spell
+    the exchange ourselves, the same way ulysses_attention does):
+
+      groups sharded over ep ->(local dispatch einsum)-> (E, G/n, C, H)
+      -> all_to_all: split E, concat G -> (E/n, G, C, H)
+      -> local expert MLP with the device's expert weight slices
+      -> inverse all_to_all -> local combine.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    return _dispatch_fn(mesh, ep_axis)(tokens, dispatch, combine, wi, wo)
+
+
 class MoEMLP(nn.Module):
     """Expert-parallel MLP block."""
     config: MoEConfig
@@ -106,6 +159,20 @@ class MoEMLP(nn.Module):
         tokens = x.reshape(-1, h)
         n_tok = tokens.shape[0]
         g = max(1, n_tok // gs)
+        if cfg.ep_axis is not None:
+            # groups are sharded over the expert axis: G must be a
+            # multiple of the axis size
+            n_ep = dict(jax.sharding.get_abstract_mesh().shape)[cfg.ep_axis]
+            g_adj = max(n_ep, (g // n_ep) * n_ep)
+            if g_adj != g:
+                logger.warning(
+                    "MoE group count adjusted %d -> %d to divide ep axis "
+                    "(size %d); per-group capacity changes vs the "
+                    "unsharded configuration", g, g_adj, n_ep)
+            g = g_adj
+            assert n_tok % g == 0, (
+                f"tokens ({n_tok}) not divisible into {g} groups for "
+                f"ep axis of size {n_ep}; adjust batch/expert_group_size")
         tokens = tokens.reshape(g, -1, h)                    # (G, S', H)
         sp = tokens.shape[1]
         capacity = max(1, int(cfg.capacity_factor * sp / e))
@@ -115,26 +182,26 @@ class MoEMLP(nn.Module):
         combine, dispatch, aux_loss = top2_gating(router, capacity)
         self.sow("intermediates", "aux_loss", aux_loss)
 
-        # dispatch: (G,S,E,C) x (G,S,H) -> (E, G, C, H)
-        expert_in = jnp.einsum("gsec,gsh->egch", dispatch.astype(x.dtype),
-                               tokens)
-        if cfg.ep_axis is not None:
-            expert_in = jax.lax.with_sharding_constraint(
-                expert_in, PartitionSpec(cfg.ep_axis))
-        # per-expert MLP via leading-dim einsums
+        # per-expert MLP weights (leading expert dim)
         wi = self.param("wi", nn.initializers.lecun_normal(),
                         (e, h, cfg.mlp_ratio * h), cfg.dtype)
         wo = self.param("wo", nn.initializers.lecun_normal(),
                         (e, cfg.mlp_ratio * h, h), cfg.dtype)
-        hmid = jnp.einsum("egch,ehm->egcm", expert_in, wi)
-        hmid = nn.gelu(hmid, approximate=True)
-        expert_out = jnp.einsum("egcm,emh->egch", hmid, wo)
+
         if cfg.ep_axis is not None:
-            expert_out = jax.lax.with_sharding_constraint(
-                expert_out, PartitionSpec(cfg.ep_axis))
-        # combine: (E,G,C,H) x (G,S,E,C) -> (G,S,H)
-        out = jnp.einsum("egch,gsec->gsh", expert_out,
-                         combine.astype(x.dtype))
+            out = _shard_map_expert_dispatch(
+                tokens, dispatch.astype(x.dtype),
+                combine.astype(x.dtype), wi, wo, cfg.ep_axis)
+        else:
+            # dispatch: (G,S,E,C) x (G,S,H) -> (E, G, C, H)
+            expert_in = jnp.einsum("gsec,gsh->egch",
+                                   dispatch.astype(x.dtype), tokens)
+            hmid = jnp.einsum("egch,ehm->egcm", expert_in, wi)
+            hmid = nn.gelu(hmid, approximate=True)
+            expert_out = jnp.einsum("egcm,emh->egch", hmid, wo)
+            # combine: (E,G,C,H) x (G,S,E,C) -> (G,S,H)
+            out = jnp.einsum("egch,gsec->gsh", expert_out,
+                             combine.astype(x.dtype))
         return out.reshape(b, s, h), aux_loss
 
 

@@ -187,8 +187,10 @@ def trace_to_closed_jaxpr(fun: Callable, *avals) -> Tuple[ClosedJaxpr, Any]:
 #   %name = (f32[4]{0}, f32[4]{0}) all-reduce-start(...)
 # Group 1 captures the opcode; operand references never match because they
 # appear inside the parens, after the opcode.
+# The type prefix may be a scalar/array type or a tuple; tuples can contain
+# parens one level deep (TPU tiled layouts like {1,0:T(8,128)}).
 _HLO_OP_RE = re.compile(
-    r"=\s*(?:\([^=]*?\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s*"
+    r"=\s*(?:\((?:[^()]|\([^()]*\))*\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s*"
     r"([a-z0-9-]+)(?:\.\d+)?\(")
 
 _COLLECTIVE_OPS = {
