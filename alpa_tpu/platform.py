@@ -1,0 +1,33 @@
+"""Process-level backend pinning helpers.
+
+The container attaches one real TPU chip through the axon PJRT plugin
+(registered by a sitecustomize on PYTHONPATH), which pins
+``jax_platforms``.  Tests and the multi-chip dryrun instead need an
+n-device virtual CPU backend; this helper is the single place that
+knows how to force it (used by ``tests/conftest.py`` and
+``__graft_entry__.dryrun_multichip``).
+"""
+import os
+
+
+def pin_cpu_platform(n_devices: int) -> None:
+    """Pin this process to an ``n_devices``-device virtual CPU backend.
+
+    Must run before the first jax backend use.  Mutates process-global
+    jax config: any later work in the same process sees the CPU
+    backend — run TPU work in a separate process.
+    """
+    import jax
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except RuntimeError as e:
+        raise RuntimeError(
+            "CPU pin ineffective — a jax backend was already initialized "
+            "in this process; call pin_cpu_platform() before any jax "
+            "operation, or run in a fresh process") from e
+    devices = jax.devices()
+    assert devices[0].platform == "cpu" and len(devices) == n_devices, (
+        f"expected {n_devices} cpu devices, got {devices}")

@@ -5,20 +5,12 @@ stand in for a pod; compile-only tests need no TPU at all.
 """
 import os
 
-# Must be set before the first backend use: force an 8-device virtual CPU
-# mesh.  (The axon sitecustomize may have imported jax already and pinned
-# jax_platforms, so we also override via jax.config below.)
-# Set ALPA_TPU_TEST_ON_TPU=1 to keep the real backend (for tests/tpu/).
+# Must run before the first backend use: force an 8-device virtual CPU
+# mesh.  Set ALPA_TPU_TEST_ON_TPU=1 to keep the real backend (tests/tpu/).
 _on_tpu = os.environ.get("ALPA_TPU_TEST_ON_TPU") == "1"
-_flags = os.environ.get("XLA_FLAGS", "")
-if not _on_tpu and "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags +
-                               " --xla_force_host_platform_device_count=8")
-import jax  # noqa: E402
-
 if not _on_tpu:
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
+    from alpa_tpu.platform import pin_cpu_platform
+    pin_cpu_platform(8)
 os.environ["ALPA_TPU_TESTING"] = "1"
 
 import pytest  # noqa: E402
