@@ -54,6 +54,24 @@ def _is_static_arg(arg) -> bool:
         hasattr(x, "aval") for x in leaves)
 
 
+def _is_state_like(arg) -> bool:
+    """True for flax TrainState(-like) args — the only auto-donate targets.
+
+    Mirrors the reference's ``auto_donate_argnums`` which donates only
+    TrainState arguments; donating anything whose (shape, dtype) happens to
+    match an output (e.g. params when the step returns grads) deletes
+    buffers the caller still holds.
+    """
+    try:
+        from flax.training import train_state
+        if isinstance(arg, train_state.TrainState):
+            return True
+    except ImportError:
+        pass
+    # duck-typed custom TrainState variants
+    return hasattr(arg, "apply_gradients") and hasattr(arg, "params")
+
+
 def _abstractify(x):
     if hasattr(x, "aval"):
         a = x.aval
@@ -99,20 +117,27 @@ class ParallelizedFunc:
         flat_args = [x for _, x in path_leaves]
         avals = tuple(_abstractify(x) for x in flat_args)
 
-        # flat flags: does this leaf belong to a batch argument?
+        # flat flags: does this leaf belong to a batch / state argument?
         batch_set = set(self.batch_argnums)
+        state_args = set(
+            i for i, a in enumerate(dyn_args) if _is_state_like(a))
         batch_invars = []
+        state_invars = []
         for (path, _x) in path_leaves:
             top = path[0].idx  # index into dyn_args tuple
             orig_idx = dyn_idx[top]
             batch_invars.append(orig_idx in batch_set)
+            state_invars.append(top in state_args)
 
         return (static_idx, static_vals, dyn_idx, flat_args, in_tree,
-                in_paths, avals, tuple(batch_invars))
+                in_paths, avals, tuple(batch_invars), tuple(state_invars))
 
-    def _infer_donation(self, flat_fun, avals, batch_invars):
-        """donate_argnums='auto': donate non-batch inputs whose (shape,dtype)
-        matches an unclaimed output leaf (i.e. state flowing to new state)."""
+    def _infer_donation(self, flat_fun, avals, batch_invars, state_invars):
+        """donate_argnums='auto': donate leaves of TrainState-like args
+        whose (shape,dtype) matches an unclaimed output leaf (state flowing
+        to new state).  Non-state args are never auto-donated — a step
+        returning (loss, grads) shape-matches every param leaf, and donating
+        params the caller still holds deletes live buffers."""
         out_shapes = jax.eval_shape(flat_fun, *avals)
         # Cache on the fun so compile paths don't re-trace (see
         # compile_shard_executable's _pin_state_out_shardings).
@@ -122,18 +147,22 @@ class ParallelizedFunc:
             pool[(tuple(o.shape), np.dtype(o.dtype))] = pool.get(
                 (tuple(o.shape), np.dtype(o.dtype)), 0) + 1
         donated = []
-        for aval, is_batch in zip(avals, batch_invars):
+        for aval, is_batch, is_state in zip(avals, batch_invars,
+                                            state_invars):
             key = (tuple(aval.shape), np.dtype(aval.dtype))
-            if not is_batch and pool.get(key, 0) > 0:
+            if is_state and not is_batch and pool.get(key, 0) > 0:
                 pool[key] -= 1
                 donated.append(True)
             else:
                 donated.append(False)
+        if any(donated):
+            logger.debug("auto-donated %d/%d input leaves (TrainState args)",
+                         sum(donated), len(donated))
         return tuple(donated)
 
     def get_executable(self, *args):
         (static_idx, static_vals, dyn_idx, flat_args, in_tree, in_paths,
-         avals, batch_invars) = self._decode_args(args)
+         avals, batch_invars, state_invars) = self._decode_args(args)
         key = (in_tree, avals, static_idx, static_vals, batch_invars)
         try:
             cached = self._executable_cache.get(key)
@@ -162,7 +191,7 @@ class ParallelizedFunc:
 
         if self.donate_argnums == "auto":
             donated_invars = self._infer_donation(flat_fun, avals,
-                                                  batch_invars)
+                                                  batch_invars, state_invars)
         else:
             donate_set = set(self.donate_argnums)
             donated_invars = tuple(

@@ -47,6 +47,9 @@ class GPTConfig:
     # mesh axis name for ring attention (sequence parallel)
     sp_axis: Optional[str] = None
     tie_embeddings: bool = True
+    # HF GPT-2 uses 1e-5 (transformers layer_norm_epsilon); flax default
+    # 1e-6 makes HF-loaded weights diverge slightly
+    layer_norm_eps: float = 1e-5
     # decoder (causal) vs encoder (bidirectional, BERT-style)
     causal: bool = True
 
@@ -166,11 +169,13 @@ class TransformerBlock(nn.Module):
     @nn.compact
     def __call__(self, x, kv_cache=None, deterministic=True):
         cfg = self.config
-        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                           name="ln1")(x)
         attn_out, new_cache = SelfAttention(cfg, name="attn")(
             ln1, kv_cache, deterministic)
         x = x + attn_out.astype(x.dtype)
-        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                           name="ln2")(x)
         x = x + MLPBlock(cfg, name="mlp")(ln2).astype(x.dtype)
         return x, new_cache
 
@@ -201,7 +206,8 @@ class GPTModel(nn.Module):
                 x, cache_i, deterministic)
             if new_caches is not None:
                 new_caches.append(new_cache)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
         if cfg.tie_embeddings:
             logits = tok_emb.attend(x.astype(cfg.dtype))
         else:

@@ -42,6 +42,7 @@ class MoEConfig:
     moe_every: int = 2
     # mesh axis to shard the expert dim over (None = let GSPMD decide)
     ep_axis: Optional[str] = None
+    layer_norm_eps: float = 1e-5
 
     def gpt(self) -> GPTConfig:
         return GPTConfig(vocab_size=self.vocab_size,
@@ -50,7 +51,8 @@ class MoEConfig:
                          num_heads=self.num_heads,
                          seq_len=self.seq_len,
                          mlp_ratio=self.mlp_ratio,
-                         dtype=self.dtype)
+                         dtype=self.dtype,
+                         layer_norm_eps=self.layer_norm_eps)
 
 
 def top2_gating(logits: jnp.ndarray, capacity: int):
@@ -163,6 +165,10 @@ class MoEMLP(nn.Module):
             # groups are sharded over the expert axis: G must be a
             # multiple of the axis size
             n_ep = dict(jax.sharding.get_abstract_mesh().shape)[cfg.ep_axis]
+            assert e % n_ep == 0, (
+                f"num_experts ({e}) must be divisible by the '{cfg.ep_axis}'"
+                f" mesh axis size ({n_ep}) for expert-parallel dispatch; "
+                "pick a divisible expert count or set ep_axis=None")
             g_adj = max(n_ep, (g // n_ep) * n_ep)
             if g_adj != g:
                 logger.warning(
@@ -213,10 +219,12 @@ class MoEBlock(nn.Module):
     def __call__(self, x):
         cfg = self.config
         gcfg = cfg.gpt()
-        ln1 = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                           name="ln1")(x)
         attn_out, _ = SelfAttention(gcfg, name="attn")(ln1)
         x = x + attn_out.astype(x.dtype)
-        ln2 = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                           name="ln2")(x)
         if self.use_moe:
             mlp_out, aux = MoEMLP(cfg, name="moe")(ln2)
         else:
@@ -249,7 +257,8 @@ class MoELMModel(nn.Module):
                        (i + 1) % cfg.moe_every == 0)
             x, aux = MoEBlock(cfg, use_moe, name=f"h{i}")(x)
             aux_total = aux_total + aux
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
+                         name="ln_f")(x)
         logits = emb.attend(x.astype(cfg.dtype))
         return logits, aux_total
 

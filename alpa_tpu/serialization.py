@@ -62,7 +62,16 @@ class _AsyncMover:
     @staticmethod
     def _move(src, dst):
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        shutil.move(src, dst)
+        if os.path.isdir(src) and os.path.isdir(dst):
+            # Merge into an existing leaf dir (another process or an
+            # earlier save already created it) — a bare shutil.move would
+            # nest src INSIDE dst and its shards would never be found.
+            for name in os.listdir(src):
+                shutil.move(os.path.join(src, name),
+                            os.path.join(dst, name))
+            os.rmdir(src)
+        else:
+            shutil.move(src, dst)
 
     def wait(self):
         for t in self.threads:
@@ -89,47 +98,58 @@ def save_checkpoint(ckpt_dir: str,
     write_dir = local_cache_dir or ckpt_dir
     os.makedirs(write_dir, exist_ok=True)
 
-    metadata = {"step": step, "leaves": {}}
+    proc = jax.process_index()
+    metadata = {"step": step, "leaves": {},
+                "n_processes": jax.process_count()}
     for path, leaf in flat.items():
         name = _leaf_dirname(path)
         leaf_dir = os.path.join(write_dir, name)
         os.makedirs(leaf_dir, exist_ok=True)
         index = []
-        if isinstance(leaf, jax.Array) and leaf.is_fully_addressable:
-            seen_slices = set()
+        if isinstance(leaf, jax.Array):
+            # Each process writes only its addressable shards (a global
+            # multi-host array is never fully addressable — do NOT fall
+            # back to np.asarray, which raises on such arrays).  Shard
+            # files are process-unique; replica_id!=0 shards are skipped
+            # so each distinct slice is written exactly once cluster-wide.
             k = 0
             for shard in leaf.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
                 sl = tuple((s.start or 0,
                             s.stop if s.stop is not None else dim)
                            for s, dim in zip(shard.index, leaf.shape)) \
                     if leaf.ndim else ()
-                if sl in seen_slices:
-                    continue  # replicated copy
-                seen_slices.add(sl)
-                np.save(os.path.join(leaf_dir, f"shard_{k}.npy"),
+                fname = f"shard_p{proc}_{k}.npy"
+                np.save(os.path.join(leaf_dir, fname),
                         np.asarray(shard.data))
-                index.append({"file": f"shard_{k}.npy",
+                index.append({"file": fname,
                               "slice": [list(x) for x in sl]})
                 k += 1
             shape, dtype = list(leaf.shape), str(leaf.dtype)
         else:
             arr = np.asarray(leaf)
-            np.save(os.path.join(leaf_dir, "shard_0.npy"), arr)
-            index.append({"file": "shard_0.npy",
-                          "slice": [[0, d] for d in arr.shape]})
+            if proc == 0:
+                np.save(os.path.join(leaf_dir, "shard_p0_0.npy"), arr)
+                index.append({"file": "shard_p0_0.npy",
+                              "slice": [[0, d] for d in arr.shape]})
             shape, dtype = list(arr.shape), str(arr.dtype)
-        with open(os.path.join(leaf_dir, "index.json"), "w",
+        with open(os.path.join(leaf_dir, f"index_p{proc}.json"), "w",
                   encoding="utf-8") as f:
             json.dump(index, f)
         metadata["leaves"][name] = {"shape": shape, "dtype": dtype}
 
-    with open(os.path.join(write_dir, "metadata.json"), "w",
-              encoding="utf-8") as f:
-        json.dump(metadata, f)
+    if proc == 0:
+        with open(os.path.join(write_dir, "metadata.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(metadata, f)
 
     if local_cache_dir:
         os.makedirs(ckpt_dir, exist_ok=True)
-        for name in list(metadata["leaves"].keys()) + ["metadata.json"]:
+        names = list(metadata["leaves"].keys())
+        if proc == 0:
+            names.append("metadata.json")
+        for name in names:
             _mover.submit(os.path.join(write_dir, name),
                           os.path.join(ckpt_dir, name))
 
@@ -139,9 +159,33 @@ def checkpoint_wait():
     _mover.wait()
 
 
-def _load_leaf(leaf_dir: str, shape, dtype, sharding=None):
-    with open(os.path.join(leaf_dir, "index.json"), encoding="utf-8") as f:
-        index = json.load(f)
+def _read_index(leaf_dir: str, n_processes: Optional[int] = None):
+    """Merge per-process index files.
+
+    ``n_processes`` (from metadata) bounds which ``index_p<i>.json`` files
+    belong to this save — files from an earlier save with more processes
+    would otherwise resurrect stale shards.  Legacy single-file
+    ``index.json`` checkpoints are read when no per-process files exist.
+    """
+    names = sorted(
+        f for f in os.listdir(leaf_dir)
+        if f.startswith("index") and f.endswith(".json"))
+    per_proc = [f for f in names if f.startswith("index_p")]
+    if per_proc:
+        if n_processes is not None:
+            keep = {f"index_p{i}.json" for i in range(n_processes)}
+            per_proc = [f for f in per_proc if f in keep]
+        names = per_proc
+    index = []
+    for fname in names:
+        with open(os.path.join(leaf_dir, fname), encoding="utf-8") as f:
+            index.extend(json.load(f))
+    return index
+
+
+def _load_leaf(leaf_dir: str, shape, dtype, sharding=None,
+               n_processes: Optional[int] = None):
+    index = _read_index(leaf_dir, n_processes)
     if sharding is None:
         # assemble the full array
         out = np.zeros(shape, dtype)
@@ -160,7 +204,7 @@ def _load_leaf(leaf_dir: str, shape, dtype, sharding=None):
             if tuple(tuple(x) for x in ent["slice"]) == global_slice:
                 return np.load(os.path.join(leaf_dir, ent["file"]))
         if full is None:
-            full = _load_leaf(leaf_dir, shape, dtype, None)
+            full = _load_leaf(leaf_dir, shape, dtype, None, n_processes)
         return full[tuple(slice(a, b) for a, b in global_slice)]
 
     ndim = len(shape)
@@ -201,7 +245,8 @@ def restore_checkpoint(ckpt_dir: str,
         leaf_dir = os.path.join(ckpt_dir, name)
         sharding = shard_flat.get(path)
         new_flat[path] = _load_leaf(leaf_dir, tuple(info["shape"]),
-                                    np.dtype(info["dtype"]), sharding)
+                                    np.dtype(info["dtype"]), sharding,
+                                    metadata.get("n_processes"))
 
     def rebuild(tree_path, sd_node):
         if isinstance(sd_node, dict):
