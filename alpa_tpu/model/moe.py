@@ -165,10 +165,12 @@ class MoEMLP(nn.Module):
             # groups are sharded over the expert axis: G must be a
             # multiple of the axis size
             n_ep = dict(jax.sharding.get_abstract_mesh().shape)[cfg.ep_axis]
-            assert e % n_ep == 0, (
-                f"num_experts ({e}) must be divisible by the '{cfg.ep_axis}'"
-                f" mesh axis size ({n_ep}) for expert-parallel dispatch; "
-                "pick a divisible expert count or set ep_axis=None")
+            if e % n_ep != 0:
+                raise ValueError(
+                    f"num_experts ({e}) must be divisible by the "
+                    f"'{cfg.ep_axis}' mesh axis size ({n_ep}) for expert-"
+                    "parallel dispatch; pick a divisible expert count or "
+                    "set ep_axis=None")
             g_adj = max(n_ep, (g // n_ep) * n_ep)
             if g_adj != g:
                 logger.warning(

@@ -62,10 +62,12 @@ class _AsyncMover:
     @staticmethod
     def _move(src, dst):
         os.makedirs(os.path.dirname(dst), exist_ok=True)
-        if os.path.isdir(src) and os.path.isdir(dst):
-            # Merge into an existing leaf dir (another process or an
-            # earlier save already created it) — a bare shutil.move would
-            # nest src INSIDE dst and its shards would never be found.
+        if os.path.isdir(src):
+            # Merge per-file into the leaf dir (concurrent processes flush
+            # the same leaf) — a bare shutil.move would nest src INSIDE an
+            # existing dst and its shards would never be found.  makedirs
+            # first so the check-then-move race cannot reintroduce nesting.
+            os.makedirs(dst, exist_ok=True)
             for name in os.listdir(src):
                 shutil.move(os.path.join(src, name),
                             os.path.join(dst, name))

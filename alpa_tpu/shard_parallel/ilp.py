@@ -21,6 +21,11 @@ from alpa_tpu.shard_parallel.strategy import StrategyGraph
 logger = logging.getLogger(__name__)
 
 
+class InfeasibleMemoryBudget(RuntimeError):
+    """No strategy assignment fits memory_budget_per_device — even the
+    minimum-footprint (fully sharded) layout exceeds the cap."""
+
+
 def solve_strategy_graph(graph: StrategyGraph,
                          time_limit: float = None,
                          memory_budget: float = None) -> List[int]:
@@ -41,15 +46,10 @@ def solve_strategy_graph(graph: StrategyGraph,
 
     try:
         return _solve_milp(graph, sizes, time_limit, memory_budget)
+    except InfeasibleMemoryBudget:
+        raise
     except Exception as e:  # pylint: disable=broad-except
-        if memory_budget:
-            logger.warning(
-                "MILP solve failed (%s); greedy fallback enforces the "
-                "memory budget only greedily — the %d-byte cap may be "
-                "exceeded", e, int(memory_budget))
-        else:
-            logger.warning("MILP solve failed (%s); using greedy fallback",
-                           e)
+        logger.warning("MILP solve failed (%s); using greedy fallback", e)
         return _solve_greedy(graph, sizes, memory_budget)
 
 
@@ -145,29 +145,41 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
 
 def _solve_greedy(graph: StrategyGraph, sizes: List[int],
                   memory_budget: float = None) -> List[int]:
-    """Greedy: process nodes in index order (invars first, then ops in
-    program order), choosing the strategy with minimal marginal cost against
-    already-decided neighbors; then one refinement sweep.
+    """Greedy: process ops first in program order, then invars (which
+    align to their consumers' decisions under the budget), choosing the
+    strategy with minimal marginal cost against already-decided neighbors;
+    then refinement sweeps.
 
-    ``memory_budget``: soft enforcement — a per-byte penalty is charged on
-    invar strategies once the running resident total exceeds the budget,
-    pushing further choices toward sharded layouts (best effort, unlike the
-    MILP's hard constraint)."""
-    choice = [0] * len(graph.nodes)
-    mem_used = [0.0]
-    decided = [False] * len(graph.nodes)
+    ``memory_budget`` is enforced HARD, like the MILP's constraint: a
+    strategy is only eligible if the running invar-resident total plus the
+    minimum possible footprint of the still-undecided invars fits the
+    budget (so feasibility is never painted into a corner).  Raises
+    :class:`InfeasibleMemoryBudget` when even the minimum-footprint layout
+    exceeds the cap."""
+    nodes = graph.nodes
+    choice = [0] * len(nodes)
+    decided = [False] * len(nodes)
     in_edges: Dict[int, List] = {}
     out_edges: Dict[int, List] = {}
     for e in graph.edges:
         in_edges.setdefault(e.dst, []).append(e)
         out_edges.setdefault(e.src, []).append(e)
 
+    invar_idx = [i for i, n in enumerate(nodes) if n.kind == "invar"]
+    min_mem = {
+        i: min(st.mem_bytes for st in nodes[i].strategies)
+        for i in invar_idx
+    }
+    if memory_budget and sum(min_mem.values()) > memory_budget:
+        raise InfeasibleMemoryBudget(
+            f"minimum resident footprint {sum(min_mem.values()):.3e} B "
+            f"exceeds memory_budget_per_device {memory_budget:.3e} B")
+    mem_used = [0.0]
+    remaining_min = [sum(min_mem.values())]
+
     def marginal(i, s):
-        st = graph.nodes[i].strategies[s]
+        st = nodes[i].strategies[s]
         cost = st.comm_cost
-        if memory_budget and graph.nodes[i].kind == "invar":
-            over = max(0.0, mem_used[0] + st.mem_bytes - memory_budget)
-            cost += over * 1e3  # strongly prefer staying under budget
         for e in in_edges.get(i, ()):
             if decided[e.src]:
                 cost += e.cost[choice[e.src], s]
@@ -176,19 +188,43 @@ def _solve_greedy(graph: StrategyGraph, sizes: List[int],
                 cost += e.cost[s, choice[e.dst]]
         return cost
 
-    order = sorted(range(len(graph.nodes)),
-                   key=lambda i: (graph.nodes[i].kind == "invar", i))
+    def feasible_set(i):
+        if not memory_budget or nodes[i].kind != "invar":
+            return range(sizes[i])
+        headroom = memory_budget - mem_used[0] - (remaining_min[0] -
+                                                  min_mem[i])
+        ok = [s for s in range(sizes[i])
+              if nodes[i].strategies[s].mem_bytes <= headroom]
+        # min-mem strategy always fits (global feasibility checked above);
+        # guard float round-off anyway
+        return ok or [int(np.argmin(
+            [st.mem_bytes for st in nodes[i].strategies]))]
+
+    order = sorted(range(len(nodes)),
+                   key=lambda i: (nodes[i].kind == "invar", i))
     for i in order:
-        costs = [marginal(i, s) for s in range(sizes[i])]
-        choice[i] = int(np.argmin(costs))
+        cand = feasible_set(i)
+        choice[i] = min(cand, key=lambda s: marginal(i, s))
         decided[i] = True
-        if memory_budget and graph.nodes[i].kind == "invar":
-            mem_used[0] += graph.nodes[i].strategies[choice[i]].mem_bytes
-    # refinement sweep
+        if memory_budget and nodes[i].kind == "invar":
+            mem_used[0] += nodes[i].strategies[choice[i]].mem_bytes
+            remaining_min[0] -= min_mem[i]
+    # refinement sweeps: re-choose each node; invar flips must keep the
+    # (now fully decided) resident total within budget
     for _ in range(2):
-        for i in range(len(graph.nodes)):
-            costs = [marginal(i, s) for s in range(sizes[i])]
-            choice[i] = int(np.argmin(costs))
+        for i in range(len(nodes)):
+            if memory_budget and nodes[i].kind == "invar":
+                cur = nodes[i].strategies[choice[i]].mem_bytes
+                headroom = memory_budget - (mem_used[0] - cur)
+                cand = [s for s in range(sizes[i])
+                        if nodes[i].strategies[s].mem_bytes <= headroom]
+            else:
+                cand = range(sizes[i])
+            new = min(cand, key=lambda s: marginal(i, s))
+            if memory_budget and nodes[i].kind == "invar":
+                mem_used[0] += (nodes[i].strategies[new].mem_bytes -
+                                nodes[i].strategies[choice[i]].mem_bytes)
+            choice[i] = new
     return choice
 
 

@@ -25,7 +25,8 @@ from alpa_tpu.device_mesh import PhysicalDeviceMesh
 from alpa_tpu.global_env import global_config
 from alpa_tpu.shard_parallel.auto_sharding import (AutoShardingOption,
                                                   MESH_AXIS_NAMES)
-from alpa_tpu.shard_parallel.ilp import solution_cost, solve_strategy_graph
+from alpa_tpu.shard_parallel.ilp import (InfeasibleMemoryBudget,
+                                         solution_cost, solve_strategy_graph)
 from alpa_tpu.shard_parallel.sharding_spec import spec_to_partition_spec
 from alpa_tpu.shard_parallel.strategy import build_strategy_graph
 
@@ -57,25 +58,39 @@ def plan_auto_sharding(fun: Callable,
                        in_paths: Sequence[str],
                        batch_flat_idx: Sequence[int],
                        physical_mesh: PhysicalDeviceMesh,
-                       option: AutoShardingOption):
+                       option: AutoShardingOption,
+                       return_graph: bool = False):
     """Search logical mesh shapes; returns
-    (jax_mesh, flat in_shardings, constraint_fn or None, chosen_shape)."""
+    (jax_mesh, flat in_shardings, constraint_fn or None, chosen_shape);
+    with ``return_graph`` also (graph, choice) of the winning solve —
+    used by fidelity tests comparing the ILP solution to compiled HLO."""
     closed_jaxpr = jax.make_jaxpr(fun)(*in_avals)
 
     best = None
     tic = time.time()
+    infeasible = None
     for shape in candidate_mesh_shapes(physical_mesh.num_devices, option,
                                        physical_mesh.num_hosts == 1):
         logical_mesh = physical_mesh.get_logical_mesh(shape)
         graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
                                      batch_flat_idx, option)
-        choice = solve_strategy_graph(graph, option.solver_timeout,
-                                      option.memory_budget_per_device)
+        try:
+            choice = solve_strategy_graph(graph, option.solver_timeout,
+                                          option.memory_budget_per_device)
+        except InfeasibleMemoryBudget as e:
+            # e.g. a (1, n) shape cannot shard a dim this shape could;
+            # another candidate may still fit the budget
+            logger.debug("mesh shape %s infeasible under memory budget: %s",
+                         shape, e)
+            infeasible = e
+            continue
         cost = solution_cost(graph, choice)
         logger.debug("mesh shape %s: cost %.4f (%s)", shape, cost,
                      graph.stats())
         if best is None or cost < best[0]:
             best = (cost, shape, logical_mesh, graph, choice)
+    if best is None:
+        raise infeasible
     cost, shape, logical_mesh, graph, choice = best
     if global_config.print_compilation_time:
         logger.warning("auto-sharding search took %.2f s; picked %s "
@@ -127,14 +142,16 @@ def plan_auto_sharding(fun: Callable,
                                                     len(aval.shape))
 
     # Emit with_sharding_constraint on solved dot outputs so GSPMD realizes
-    # the ILP's intra-op plan exactly.  Skipped when a remat/checkpoint
-    # boundary was inlined for analysis (re-evaluating the flattened eqns
-    # would lose rematerialization) or when disabled by option.
+    # the ILP's intra-op plan exactly.  The constrained function re-wraps
+    # remat/checkpoint bodies in jax.checkpoint, so rematerialization is
+    # preserved (constraints land inside the checkpointed body).
     constraint_fn = None
-    if option.emit_sharding_constraints and not graph.has_remat:
+    if option.emit_sharding_constraints:
         from alpa_tpu.shard_parallel.strategy import make_constrained_fun
         constraint_fn = make_constrained_fun(
             graph, choice, jax_mesh, axis_names, closed_jaxpr.consts,
             min_elements=option.constrain_min_elements)
 
+    if return_graph:
+        return jax_mesh, in_shardings, constraint_fn, shape, (graph, choice)
     return jax_mesh, in_shardings, constraint_fn, shape

@@ -18,12 +18,13 @@ auto_sharding_solver/``).  We build a *strategy graph*:
   cost matrix C[s_src, s_dst].
 
 The ILP (ilp.py) picks one strategy per node minimizing node + edge costs;
-invar decisions become pjit in_shardings.  GSPMD propagation then realizes
-the dot strategies; emitting with_sharding_constraint on dot outputs (via
-Node.outvar) is the planned fidelity upgrade for cases where propagation
-disagrees with the ILP.
+invar decisions become pjit in_shardings, and ``make_constrained_fun``
+re-interprets the jaxpr inserting ``with_sharding_constraint`` on every
+solved op output (via Node.outvar) so GSPMD realizes the ILP's plan even
+where propagation would disagree.
 """
 import dataclasses
+import functools
 import itertools
 import logging
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -147,6 +148,35 @@ class StrategyGraph:
 ########################################
 
 
+def _inline_site(eqn, depth: int):
+    """Resolve an inlinable call site: ``(sub_jaxpr, consts)`` or None.
+
+    Single source of truth for INLINE_PRIMS membership, the depth cap and
+    the param-key lookup.  The flatten traversal, ``_check_evaluable`` and
+    the constrained re-interpreter MUST agree on this (constraints attach
+    by position in the flattened eqn order), so they all call here.
+    """
+    if eqn.primitive.name not in INLINE_PRIMS or depth >= 6:
+        return None
+    sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or
+           eqn.params.get("fun_jaxpr"))
+    if sub is None:
+        return None
+    if isinstance(sub, ClosedJaxpr):
+        return sub.jaxpr, sub.consts
+    return sub, []
+
+
+def _align_call_args(outer: list, inner_invars) -> list:
+    """pjit-style calls line invars up 1:1; custom_jvp has extra prefix
+    args — align from the end.  Pads (with None) when there are fewer
+    outer args than inner invars (such sites are not re-evaluable; see
+    ``_check_evaluable``)."""
+    if len(outer) >= len(inner_invars):
+        return outer[len(outer) - len(inner_invars):]
+    return list(outer) + [None] * (len(inner_invars) - len(outer))
+
+
 def _subst(v, env):
     if isinstance(v, Literal):
         return v
@@ -181,29 +211,16 @@ def flatten_jaxpr_eqns(jaxpr: Jaxpr, env: Optional[dict] = None,
     out = []
     for eqn in jaxpr.eqns:
         prim = eqn.primitive.name
-        if prim in INLINE_PRIMS and depth < 6:
+        site = _inline_site(eqn, depth)
+        if site is not None:
             if info is not None and prim in ("remat", "checkpoint",
                                              "remat2"):
                 info["has_remat"] = True
-            sub = (eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") or
-                   eqn.params.get("fun_jaxpr"))
-            if sub is None:
-                out.append(eqn.replace(
-                    invars=[_subst(v, env) for v in eqn.invars]))
-                continue
-            sub_jaxpr = sub.jaxpr if isinstance(sub, ClosedJaxpr) else sub
-            consts = sub.consts if isinstance(sub, ClosedJaxpr) else []
+            sub_jaxpr, consts = site
             inner_env = {}
-            n_const = len(sub_jaxpr.constvars)
-            # pjit-style: invars line up 1:1; custom_jvp has extra prefix
-            # args — align from the end.
             outer_in = [_subst(v, env) for v in eqn.invars]
             inner_invars = list(sub_jaxpr.invars)
-            if len(outer_in) >= len(inner_invars):
-                aligned = outer_in[len(outer_in) - len(inner_invars):]
-            else:
-                aligned = outer_in + [None] * (len(inner_invars) -
-                                               len(outer_in))
+            aligned = _align_call_args(outer_in, inner_invars)
             for iv, ov in zip(inner_invars, aligned):
                 if ov is not None:
                     inner_env[iv] = ov
@@ -838,6 +855,7 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                     edges.append(Edge(src_idx, n.idx, C))
 
     graph = StrategyGraph(nodes, edges, logical_mesh)
+    graph.closed_jaxpr = closed_jaxpr
     graph.flat_eqns = flat_eqns
     graph.invars = list(jaxpr.invars)
     graph.constvars = list(jaxpr.constvars)
@@ -848,92 +866,160 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
     return graph
 
 
+def _check_evaluable(jaxpr: Jaxpr, depth: int = 0) -> bool:
+    """Mirror of the flatten traversal: True iff every inline site can be
+    re-evaluated (enough outer args to bind the inner jaxpr's invars)."""
+    for eqn in jaxpr.eqns:
+        site = _inline_site(eqn, depth)
+        if site is None:
+            continue
+        sub_jaxpr, _ = site
+        if len(eqn.invars) < len(sub_jaxpr.invars):
+            return False
+        if not _check_evaluable(sub_jaxpr, depth + 1):
+            return False
+    return True
+
+
 def make_constrained_fun(graph: StrategyGraph, choice, jax_mesh,
                          axis_names, consts, min_elements: int = 1 << 16):
-    """Build a function that re-evaluates the (flattened) jaxpr inserting
-    ``with_sharding_constraint`` on every solved dot output — so GSPMD
-    realizes exactly the ILP's intra-op plan instead of relying on
+    """Build a function re-evaluating the ORIGINAL jaxpr with
+    ``with_sharding_constraint`` inserted on every solved dot output — so
+    GSPMD realizes exactly the ILP's intra-op plan instead of relying on
     propagation (the fidelity upgrade promised by this module's header).
 
-    The flattened eqn list is post-autodiff (planning happens on the traced
-    train step), so evaluating inlined custom-vjp/pjit bodies directly is
-    semantically equivalent; non-inlined eqns (scan/while/...) are bound
-    as-is.
+    The interpreter recurses into the same call primitives the analysis
+    flattening inlines, in the same order, so the ILP's decisions (keyed by
+    position in ``graph.flat_eqns``) attach to the right ``bind`` even
+    though flattening freshens variable identities.  remat/checkpoint
+    bodies are re-wrapped in ``jax.checkpoint`` (same policy/prevent_cse),
+    preserving rematerialization — the constraint lands INSIDE the
+    checkpointed body.
     """
     import jax as _jax
     from alpa_tpu.shard_parallel.sharding_spec import (is_replicated,
                                                        spec_to_partition_spec)
 
-    # dot outvar -> NamedSharding of the chosen strategy.  Tensors below
-    # ``min_elements`` (AutoShardingOption.constrain_min_elements) are left
-    # to propagation: pinning them can force GSPMD into "involuntary full
-    # rematerialization" transitions that cost more than the constraint is
-    # worth.
-    constraints = {}
+    from jax.sharding import NamedSharding
+
+    def _sharding(spec):
+        return NamedSharding(jax_mesh,
+                             spec_to_partition_spec(spec, axis_names))
+
+    def _too_small(aval):
+        return (min_elements and getattr(aval, "shape", None) and
+                int(np.prod(aval.shape)) < min_elements)
+
+    # Solved op node -> constraints on its outvar AND its operands.
+    # Pinning only the output is not enough for fidelity: for a
+    # contracting-dim (k) dot strategy GSPMD is free to all-gather the
+    # operands and compute the full dot locally unless the operands'
+    # chosen shardings are pinned too (the reference C++ pass annotates
+    # operand shardings for the same reason).  Tensors below
+    # ``min_elements`` (AutoShardingOption.constrain_min_elements) are
+    # left to propagation: pinning tiny tensors can force GSPMD
+    # transitions that cost more than the constraint is worth.
+    var_pos = {}
+    for ei, e in enumerate(graph.flat_eqns):
+        for oi, ov in enumerate(e.outvars):
+            if isinstance(ov, Var):
+                var_pos[ov] = (ei, oi)
+    flat_eqns = graph.flat_eqns
+    out_cons = {}   # (eqn_pos, out_idx) -> NamedSharding
+    in_cons = {}    # (eqn_pos, operand_idx) -> NamedSharding
     for node, s in zip(graph.nodes, choice):
-        if node.kind == "op" and node.outvar is not None:
-            aval = node.outvar.aval
-            if (min_elements and getattr(aval, "shape", None) and
-                    int(np.prod(aval.shape)) < min_elements):
+        if node.kind != "op" or node.outvar is None:
+            continue
+        if node.outvar not in var_pos:
+            continue
+        pos, oi = var_pos[node.outvar]
+        strat = node.strategies[s]
+        if not is_replicated(strat.out_spec) and not _too_small(
+                node.outvar.aval):
+            out_cons[(pos, oi)] = _sharding(strat.out_spec)
+        eqn = flat_eqns[pos]
+        for ii, op_spec in enumerate(strat.operand_specs):
+            if ii >= len(eqn.invars) or is_replicated(op_spec):
                 continue
-            spec = node.strategies[s].out_spec
-            if not is_replicated(spec):
-                from jax.sharding import NamedSharding
-                constraints[node.outvar] = NamedSharding(
-                    jax_mesh, spec_to_partition_spec(spec, axis_names))
-    if not constraints:
+            v = eqn.invars[ii]
+            if isinstance(v, Literal) or _too_small(v.aval):
+                continue
+            in_cons[(pos, ii)] = _sharding(op_spec)
+    if not out_cons and not in_cons:
         return None
 
-    flat_eqns = graph.flat_eqns
-    invars = graph.invars
-    constvars = graph.constvars
-    outvars = graph.outvars
-    captured = graph.captured_consts
-
-    # Validate the flattened view is complete: every outvar and eqn invar
-    # must be defined.  If not (an inlining pattern we don't model), skip
-    # constraint emission rather than failing at trace time.
-    defined = set(invars) | set(constvars) | set(captured)
-    for e in flat_eqns:
-        defined.update(e.outvars)
-    bad = [v for v in outvars if isinstance(v, Var) and v not in defined]
-    for e in flat_eqns:
-        for v in e.invars:
-            if isinstance(v, Var) and v not in defined:
-                bad.append(v)
-    if bad:
-        logger.debug(
-            "skipping sharding-constraint emission: %d unresolved vars "
-            "(first: %s)", len(bad), bad[0])
+    root = graph.closed_jaxpr
+    if root is None or not _check_evaluable(root.jaxpr):
+        logger.warning(
+            "skipping sharding-constraint emission: an inlined call site "
+            "cannot be re-evaluated (fewer outer args than inner invars)")
         return None
 
     def constrained(*args):
-        env = {}
-        for v, a in zip(invars, args):
-            env[v] = a
-        for v, c in zip(constvars, consts):
-            env[v] = c
-        env.update(captured)
+        counter = [0]  # position in the flattened eqn order
 
-        def read(v):
-            if isinstance(v, Literal):
-                return v.val
-            return env[v]
+        def eval_jaxpr(jaxpr, jconsts, jargs, depth):
+            env = {}
+            for v, c in zip(jaxpr.constvars, jconsts):
+                env[v] = c
+            for v, a in zip(jaxpr.invars, jargs):
+                env[v] = a
 
-        for eqn in flat_eqns:
-            if eqn.primitive.name == "pipeline":
-                for iv, ov in zip(eqn.invars, eqn.outvars):
-                    env[ov] = read(iv)
-                continue
-            vals = [read(v) for v in eqn.invars]
-            ans = eqn.primitive.bind(*vals, **eqn.params)
-            if not eqn.primitive.multiple_results:
-                ans = [ans]
-            for ov, a in zip(eqn.outvars, ans):
-                if ov in constraints:
-                    a = _jax.lax.with_sharding_constraint(
-                        a, constraints[ov])
-                env[ov] = a
-        return [read(v) for v in outvars]
+            def read(v):
+                if isinstance(v, Literal):
+                    return v.val
+                return env[v]
+
+            for eqn in jaxpr.eqns:
+                prim = eqn.primitive.name
+                site = _inline_site(eqn, depth)
+                if site is not None:
+                    sub_jaxpr, sub_consts = site
+                    outer_in = [read(v) for v in eqn.invars]
+                    aligned = _align_call_args(outer_in, sub_jaxpr.invars)
+                    if prim in ("remat", "checkpoint", "remat2"):
+                        fn = functools.partial(
+                            _remat_body, eval_jaxpr, sub_jaxpr, sub_consts,
+                            depth)
+                        fn = _jax.checkpoint(
+                            fn,
+                            policy=eqn.params.get("policy"),
+                            prevent_cse=eqn.params.get("prevent_cse", True))
+                        ans = fn(*aligned)
+                    else:
+                        ans = eval_jaxpr(sub_jaxpr, sub_consts, aligned,
+                                         depth + 1)
+                    for ov, a in zip(eqn.outvars, ans):
+                        env[ov] = a
+                    continue
+                if prim == "pipeline":
+                    # boundary marker: identity passthrough (one flat slot)
+                    counter[0] += 1
+                    for iv, ov in zip(eqn.invars, eqn.outvars):
+                        env[ov] = read(iv)
+                    continue
+                pos = counter[0]
+                counter[0] += 1
+                vals = [read(v) for v in eqn.invars]
+                for ii in range(len(vals)):
+                    sh = in_cons.get((pos, ii))
+                    if sh is not None:
+                        vals[ii] = _jax.lax.with_sharding_constraint(
+                            vals[ii], sh)
+                ans = eqn.primitive.bind(*vals, **eqn.params)
+                if not eqn.primitive.multiple_results:
+                    ans = [ans]
+                for oi, (ov, a) in enumerate(zip(eqn.outvars, ans)):
+                    sh = out_cons.get((pos, oi))
+                    if sh is not None:
+                        a = _jax.lax.with_sharding_constraint(a, sh)
+                    env[ov] = a
+            return [read(v) for v in jaxpr.outvars]
+
+        return eval_jaxpr(root.jaxpr, consts, args, 0)
 
     return constrained
+
+
+def _remat_body(eval_jaxpr, sub_jaxpr, sub_consts, depth, *args):
+    return eval_jaxpr(sub_jaxpr, sub_consts, list(args), depth + 1)

@@ -172,6 +172,152 @@ class TestConstraintEmission:
 
         assert count_nonreplicated(200_000) > count_nonreplicated(None)
 
+    def test_remat_survives_constraint_emission(self):
+        """Constraint emission used to be skipped whenever remat was
+        present; now the constrained function re-wraps checkpoint bodies,
+        so remat2 AND sharding_constraint coexist in the traced jaxpr."""
+        from alpa_tpu.device_mesh import get_global_cluster
+        from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+
+        alpa_tpu.init("local")
+        mesh = get_global_cluster().get_physical_mesh()
+        D = 512
+
+        def fn(w1, w2, x):
+
+            @jax.checkpoint
+            def blk(x):
+                return jnp.tanh(x @ w1)
+
+            h = blk(x)
+            return jax.grad(lambda w: jnp.tanh(h @ w).sum())(w2)
+
+        avals = [
+            jax.ShapeDtypeStruct((D, D), jnp.float32),
+            jax.ShapeDtypeStruct((D, D), jnp.float32),
+            jax.ShapeDtypeStruct((8, D), jnp.float32),
+        ]
+        _, in_sh, cfn, _ = plan_auto_sharding(fn, avals, ["w1", "w2", "x"],
+                                              [2], mesh,
+                                              AutoShardingOption())
+        assert cfn is not None
+
+        def prims(jx, acc):
+            for e in jx.eqns:
+                acc.append(e.primitive.name)
+                for v in e.params.values():
+                    if hasattr(v, "jaxpr"):
+                        prims(v.jaxpr, acc)
+                    elif hasattr(v, "eqns"):
+                        prims(v, acc)
+            return acc
+
+        allp = prims(jax.make_jaxpr(cfn)(*avals).jaxpr, [])
+        assert "remat2" in allp, set(allp)
+        assert "sharding_constraint" in allp, set(allp)
+        rs = np.random.RandomState(0)
+        args = [jnp.asarray(rs.randn(*a.shape).astype(np.float32))
+                for a in avals]
+        want = fn(*args)
+        got = cfn(*args)[0]
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_ilp_choice_realized_in_hlo_gpt(self):
+        """Fidelity: the all-reduces in compiled HLO equal the comm-bearing
+        strategies the ILP chose (planner choice == HLO reality)."""
+        from alpa_tpu.device_mesh import get_global_cluster
+        from alpa_tpu.model.gpt_model import GPTConfig, TransformerBlock
+        from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+
+        alpa_tpu.init("local")
+        mesh = get_global_cluster().get_physical_mesh()
+        cfg = GPTConfig(hidden_size=512, num_layers=1, num_heads=8,
+                        seq_len=64, vocab_size=256)
+        block = TransformerBlock(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (4, 64, 512))
+        params = block.init(rng, x)
+        flat, tree = jax.tree_util.tree_flatten((params, x))
+        avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat]
+
+        def flat_fn(*leaves):
+            p, xx = jax.tree_util.tree_unflatten(tree, leaves)
+            out, _ = block.apply(p, xx)
+            return out
+
+        opt = AutoShardingOption(logical_mesh_shape=(1, 8),
+                                 constrain_min_elements=0)
+        batch_idx = [i for i, a in enumerate(flat) if a.shape[:1] == (4,)]
+        _, in_sh, cfn, _, (graph, choice) = plan_auto_sharding(
+            flat_fn, avals, [""] * len(avals), batch_idx, mesh, opt,
+            return_graph=True)
+        assert cfn is not None
+        planned = sum(1 for n, s in zip(graph.nodes, choice)
+                      if n.kind == "op" and n.outvar is not None and
+                      n.strategies[s].comm_cost > 0)
+        assert planned >= 1  # shapes chosen so TP-style comm is planned
+        hlo = jax.jit(cfn, in_shardings=in_sh).lower(*avals).compile() \
+            .as_text()
+        _, n_ar, _, _, _ = count_communication_primitives(hlo)
+        assert n_ar == planned, (planned, n_ar)
+
+    def test_ilp_choice_realized_in_hlo_conv(self):
+        """Conv analog of the GPT fidelity test, on a compact conv tower
+        (GSPMD retains some realization freedom on full WResNet — same-
+        cost all-gather realizations — so the deterministic assertion
+        lives on a small tower; WResNet coverage is the planner test
+        below)."""
+        from flax import linen as nn
+
+        from alpa_tpu.device_mesh import get_global_cluster
+        from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+        from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+
+        alpa_tpu.init("local")
+        mesh = get_global_cluster().get_physical_mesh()
+
+        class Tower(nn.Module):
+
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Conv(256, (3, 3), use_bias=False)(x)
+                x = nn.relu(x)
+                x = nn.Conv(256, (3, 3), use_bias=False)(x)
+                x = nn.relu(x)
+                return nn.Conv(256, (1, 1), use_bias=False)(x)
+
+        model = Tower()
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 16, 16, 256))
+        params = model.init(rng, x)
+        flat, tree = jax.tree_util.tree_flatten((params, x))
+        avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat]
+
+        def flat_fn(*leaves):
+            p, xx = jax.tree_util.tree_unflatten(tree, leaves)
+            return model.apply(p, xx)
+
+        opt = AutoShardingOption(logical_mesh_shape=(1, 8),
+                                 constrain_min_elements=0)
+        batch_idx = [i for i, a in enumerate(flat)
+                     if a.shape[:1] == (2,) and len(a.shape) == 4]
+        _, in_sh, cfn, _, (graph, choice) = plan_auto_sharding(
+            flat_fn, avals, [""] * len(avals), batch_idx, mesh, opt,
+            return_graph=True)
+        planned = sum(1 for n, s in zip(graph.nodes, choice)
+                      if n.kind == "op" and n.outvar is not None and
+                      n.strategies[s].comm_cost > 0)
+        if cfn is None:
+            assert planned == 0
+            return
+        hlo = jax.jit(cfn, in_shardings=in_sh).lower(*avals).compile() \
+            .as_text()
+        _, n_ar, _, _, _ = count_communication_primitives(hlo)
+        assert n_ar == planned, (planned, n_ar)
+
     def test_wresnet_conv_planner_chooses_parallelism(self):
         """Convolutions get real strategies (batch/channel roles), not
         replication barriers: the planner must shard the image batch."""

@@ -1,0 +1,77 @@
+"""ILP / greedy-fallback unit tests (no device work).
+
+The greedy fallback must enforce ``memory_budget_per_device`` as hard as
+the MILP does (ref auto_sharding's memory constraint) — an OOM layout
+must never be silently "chosen".
+"""
+import numpy as np
+import pytest
+
+from alpa_tpu.shard_parallel.ilp import (InfeasibleMemoryBudget,
+                                         _solve_greedy, solve_strategy_graph)
+from alpa_tpu.shard_parallel.strategy import (Edge, Node, Strategy,
+                                              StrategyGraph)
+
+
+def _invar_node(idx, mem_options):
+    """An invar node with (replicated, sharded) strategies: the replicated
+    one is comm-free but heavy; the sharded one costs comm but is light."""
+    strategies = [
+        Strategy(name=f"s{k}", out_spec=(), comm_cost=float(k),
+                 mem_bytes=float(m))
+        for k, m in enumerate(mem_options)
+    ]
+    return Node(idx=idx, kind="invar", aval=None, strategies=strategies,
+                invar_idx=idx)
+
+
+def _graph(nodes, edges=()):
+    return StrategyGraph(list(nodes), list(edges), None)
+
+
+class TestGreedyMemoryBudget:
+
+    def test_budget_respected(self):
+        # replicated = 100 B (cost 0), sharded = 10 B (cost 1) per node;
+        # budget 50 forces sharded everywhere despite higher comm cost.
+        g = _graph([_invar_node(i, [100, 10]) for i in range(4)])
+        choice = _solve_greedy(g, [2] * 4, memory_budget=50)
+        used = sum(g.nodes[i].strategies[choice[i]].mem_bytes
+                   for i in range(4))
+        assert used <= 50, (choice, used)
+        assert choice == [1, 1, 1, 1]
+
+    def test_partial_budget_picks_cheapest_mix(self):
+        # budget lets exactly one node stay replicated
+        g = _graph([_invar_node(i, [100, 10]) for i in range(4)])
+        choice = _solve_greedy(g, [2] * 4, memory_budget=130)
+        used = sum(g.nodes[i].strategies[choice[i]].mem_bytes
+                   for i in range(4))
+        assert used <= 130, (choice, used)
+        assert sum(1 for c in choice if c == 0) == 1
+
+    def test_infeasible_raises(self):
+        g = _graph([_invar_node(i, [100, 10]) for i in range(4)])
+        with pytest.raises(InfeasibleMemoryBudget):
+            _solve_greedy(g, [2] * 4, memory_budget=30)
+
+    def test_infeasible_propagates_through_driver(self):
+        g = _graph([_invar_node(i, [100, 10]) for i in range(4)])
+        with pytest.raises(InfeasibleMemoryBudget):
+            solve_strategy_graph(g, time_limit=1, memory_budget=30)
+
+    def test_refinement_cannot_break_budget(self):
+        # An edge strongly prefers node 1 replicated; the budget forbids
+        # both nodes replicated — refinement must not flip into OOM.
+        n0 = _invar_node(0, [100, 10])
+        n1 = _invar_node(1, [100, 10])
+        cost = np.array([[0.0, 500.0], [500.0, 500.0]])
+        g = _graph([n0, n1], [Edge(0, 1, cost)])
+        choice = _solve_greedy(g, [2, 2], memory_budget=120)
+        used = sum(g.nodes[i].strategies[choice[i]].mem_bytes
+                   for i in (0, 1))
+        assert used <= 120, (choice, used)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
