@@ -57,7 +57,8 @@ class LogicalDeviceMesh:
                  physical_mesh: Optional["PhysicalDeviceMesh"],
                  id_mesh: np.ndarray,
                  mesh_alpha: Optional[Sequence[float]] = None,
-                 mesh_beta: Optional[Sequence[float]] = None):
+                 mesh_beta: Optional[Sequence[float]] = None,
+                 calibration: Optional[Any] = None):
         self.physical_mesh = physical_mesh
         self.id_mesh = np.asarray(id_mesh)
         # Default constants: axis 0 = slower axis (DCN / cross-host),
@@ -69,6 +70,32 @@ class LogicalDeviceMesh:
             self.mesh_beta = tuple(mesh_beta)
         else:
             self.mesh_beta = tuple([0.1] + [0.01] * (ndim - 1))[:ndim]
+        # Measured per-collective (alpha s, beta s/byte) fits
+        # (mesh_profiling.CalibratedCostModel); when present every cost
+        # query returns real seconds instead of abstract units.
+        self.calibration = calibration
+
+    @property
+    def calibrated(self) -> bool:
+        return self.calibration is not None
+
+    def _ab(self, kind: str, mesh_dim: int):
+        """(alpha, beta, tie) for one collective kind on one axis.  The
+        tie term keeps the abstract model's AG > AR > RS > A2A bias; with
+        a measured calibration the real numbers differentiate choices, so
+        the tie is dropped.  The calibration is measured on the fast
+        (intra-host/ICI) fabric; a slower axis (higher abstract beta,
+        e.g. DCN) scales the measured beta by the abstract ratio so the
+        cross-host penalty survives calibration."""
+        if self.calibration is not None:
+            ab = self.calibration.alpha_beta(kind)
+            if ab is not None:
+                ratio = self.mesh_beta[mesh_dim] / min(self.mesh_beta)
+                return ab[0], ab[1] * ratio, 0.0
+        ties = {"all_gather": 0.1, "all_reduce": 0.01,
+                "reduce_scatter": 0.001, "all_to_all": 0.001}
+        return (self.mesh_alpha[mesh_dim], self.mesh_beta[mesh_dim],
+                ties[kind])
 
     @property
     def shape(self):
@@ -86,31 +113,29 @@ class LogicalDeviceMesh:
         n = self.shape[mesh_dim]
         if n == 1:
             return 0.0
-        return (self.mesh_alpha[mesh_dim] +
-                self.mesh_beta[mesh_dim] * (n - 1) / n * num_bytes + 0.1)
+        a, b, tie = self._ab("all_gather", mesh_dim)
+        return a + b * (n - 1) / n * num_bytes + tie
 
     def all_reduce_cost(self, num_bytes: float, mesh_dim: int) -> float:
         n = self.shape[mesh_dim]
         if n == 1:
             return 0.0
-        return (self.mesh_alpha[mesh_dim] +
-                self.mesh_beta[mesh_dim] * 2 * (n - 1) / n * num_bytes + 0.01)
+        a, b, tie = self._ab("all_reduce", mesh_dim)
+        return a + b * 2 * (n - 1) / n * num_bytes + tie
 
     def reduce_scatter_cost(self, num_bytes: float, mesh_dim: int) -> float:
         n = self.shape[mesh_dim]
         if n == 1:
             return 0.0
-        return (self.mesh_alpha[mesh_dim] +
-                self.mesh_beta[mesh_dim] * (n - 1) / n * num_bytes + 0.001)
+        a, b, tie = self._ab("reduce_scatter", mesh_dim)
+        return a + b * (n - 1) / n * num_bytes + tie
 
     def all_to_all_cost(self, num_bytes: float, mesh_dim: int) -> float:
         n = self.shape[mesh_dim]
         if n == 1:
             return 0.0
-        penalty = 1.0
-        return (self.mesh_alpha[mesh_dim] +
-                self.mesh_beta[mesh_dim] * (n - 1) / (n * n) * num_bytes * penalty
-                + 0.001)
+        a, b, tie = self._ab("all_to_all", mesh_dim)
+        return a + b * (n - 1) / (n * n) * num_bytes + tie
 
     def resharding_cost_mixed(self, num_bytes: float) -> float:
         """Cost of an unmodeled layout change (conservative: allgather all)."""
@@ -195,7 +220,11 @@ class PhysicalDeviceMesh:
                                 stride * s > ndph)
                 betas.append(0.1 if crosses_host else 0.01)
             mesh_beta = tuple(betas)
-        return LogicalDeviceMesh(self, id_mesh, mesh_alpha, mesh_beta)
+        # attach the process-global measured calibration (if a profiling
+        # DB is loaded) so ILP costs are real seconds
+        from alpa_tpu.mesh_profiling import get_global_calibration
+        return LogicalDeviceMesh(self, id_mesh, mesh_alpha, mesh_beta,
+                                 calibration=get_global_calibration())
 
     def get_jax_mesh(self,
                      axis_names: Sequence[str] = ("data", "model"),

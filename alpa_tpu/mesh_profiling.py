@@ -1,21 +1,27 @@
-"""Mesh profiling database + static cost estimation.
+"""Mesh profiling database + calibrated cost estimation.
 
 Analog of ref ``alpa/mesh_profiling.py`` (SURVEY.md §2.8): the cost-model
 side of auto stage construction.  Two paths, like the reference:
 
 * ``ProfilingResultDatabase`` — measured dot/collective costs per mesh
-  signature, picklable, filled by ``profile_all`` on real hardware
-  (ref ProfilingResultDatabase:162 / profile_all:725).
-* ``estimate_stage_cost`` — pure static model (ref
+  signature, JSON-persisted, filled by ``profile_all`` on real hardware
+  (ref ProfilingResultDatabase:162 / profile_all:725).  A
+  ``CalibratedCostModel`` fitted from the measurements supplies
+  seconds-per-flop (size-dependent) and per-collective alpha/beta in real
+  seconds, which the ``LogicalDeviceMesh`` cost queries and the stage DP
+  consume — so "auto" decisions trace back to measured numbers instead of
+  abstract units.
+* ``estimate_stage_cost`` — static model (ref
   ``estimate_hlo_module_cost:901`` / HloCostModelProfileWorker): analytic
-  flops / collective alpha-beta over the LogicalDeviceMesh, used as the
-  default on TPU where spinning up submeshes to profile is slow
-  (SURVEY.md §7 hard part 2).
+  flops + the intra-op ILP objective, used as the default on TPU where
+  spinning up submeshes to profile is slow (SURVEY.md §7 hard part 2).
+  When the logical mesh carries a calibration, every term is in seconds.
 """
+import dataclasses
+import json
 import logging
-import pickle
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -24,35 +30,112 @@ from alpa_tpu.util import benchmark_func, jaxpr_eqn_flops
 
 logger = logging.getLogger(__name__)
 
-# Rough per-chip peak for cost normalization (abstract units are fine: the
-# DP only compares costs; absolute scale cancels).  Seconds per flop.
+# Fallback per-chip peak when no profiling DB is loaded (abstract units are
+# fine then: the DP only compares costs).  Seconds per flop.
 DEFAULT_SEC_PER_FLOP = 1.0 / 100e12
+
+COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
+                    "all_to_all")
+
+
+@dataclasses.dataclass
+class CalibratedCostModel:
+    """Fitted from a MeshProfilingResult; all values in real seconds.
+
+    ``dot_points``: sorted (flops, sec/flop) samples — matmul efficiency
+    is size-dependent (small ops underutilize the MXU), so seconds-per-
+    flop interpolates over the measured ladder.
+    ``collective_ab``: kind -> (alpha latency s, beta s/byte), fitted by
+    least squares on t = alpha + beta * ring_bytes.
+    """
+    dot_points: List[Tuple[float, float]]
+    collective_ab: Dict[str, Tuple[float, float]]
+
+    def sec_per_flop(self, flops: float = 1e12) -> float:
+        if not self.dot_points:
+            return DEFAULT_SEC_PER_FLOP
+        pts = sorted(self.dot_points)
+        xs = np.array([p[0] for p in pts], float)
+        ys = np.array([p[1] for p in pts], float)
+        return float(np.interp(flops, xs, ys))
+
+    def alpha_beta(self, kind: str) -> Optional[Tuple[float, float]]:
+        return self.collective_ab.get(kind)
 
 
 class MeshProfilingResult:
-    """Measured costs for one mesh signature (ref MeshProfilingResult:18)."""
+    """Measured costs for one mesh signature (ref MeshProfilingResult:18).
+
+    Collective entries record (ring_bytes, seconds) where ring_bytes
+    already includes the ring factor ((n-1)/n per pass), so alpha-beta
+    fits transfer across axis sizes.
+    """
 
     def __init__(self):
-        # op name -> list[(size, seconds)]
+        # kind -> key -> list[(size, seconds)]
         self.dot_cost_dict: Dict[Tuple, List] = {}
         self.all_reduce_cost_dict: Dict[Tuple, List] = {}
         self.all_gather_cost_dict: Dict[Tuple, List] = {}
         self.reduce_scatter_cost_dict: Dict[Tuple, List] = {}
         self.all_to_all_cost_dict: Dict[Tuple, List] = {}
 
-    def record(self, kind: str, key: Tuple, size: int, seconds: float):
-        getattr(self, f"{kind}_cost_dict").setdefault(key, []).append(
-            (size, seconds))
+    def record(self, kind: str, key: Tuple, size: float, seconds: float):
+        getattr(self, f"{kind}_cost_dict").setdefault(tuple(key), []).append(
+            (float(size), float(seconds)))
 
-    def estimate(self, kind: str, key: Tuple, size: int) -> Optional[float]:
+    def estimate(self, kind: str, key: Tuple, size: float) -> Optional[float]:
         """Linear interpolation on measured (size, time) points."""
-        points = getattr(self, f"{kind}_cost_dict").get(key)
+        points = getattr(self, f"{kind}_cost_dict").get(tuple(key))
         if not points:
             return None
         points = sorted(points)
         sizes = np.array([p[0] for p in points], dtype=float)
         times = np.array([p[1] for p in points], dtype=float)
         return float(np.interp(size, sizes, times))
+
+    def fit(self) -> CalibratedCostModel:
+        """Least-squares alpha-beta per collective kind + dot efficiency
+        curve (ref: the reference interpolates its profiled op dicts;
+        here we additionally expose the fitted line so costs extrapolate
+        to unmeasured sizes)."""
+        dot_points = []
+        for points in self.dot_cost_dict.values():
+            for flops, sec in points:
+                if flops > 0:
+                    dot_points.append((float(flops), sec / flops))
+        ab = {}
+        for kind in COLLECTIVE_KINDS:
+            pts = []
+            for points in getattr(self, f"{kind}_cost_dict").values():
+                pts.extend(points)
+            if len(pts) >= 2:
+                x = np.array([p[0] for p in pts], float)
+                y = np.array([p[1] for p in pts], float)
+                A = np.stack([np.ones_like(x), x], axis=1)
+                (alpha, beta), *_ = np.linalg.lstsq(A, y, rcond=None)
+                ab[kind] = (max(float(alpha), 0.0), max(float(beta), 1e-15))
+            elif len(pts) == 1:
+                size, sec = pts[0]
+                ab[kind] = (0.0, max(sec / max(size, 1.0), 1e-15))
+        return CalibratedCostModel(sorted(dot_points), ab)
+
+    # ---- (de)serialization: JSON-friendly ----
+    def to_json(self) -> Dict:
+        out = {}
+        for kind in ("dot",) + COLLECTIVE_KINDS:
+            d = getattr(self, f"{kind}_cost_dict")
+            out[kind] = {json.dumps(list(k)): v for k, v in d.items()}
+        return out
+
+    @classmethod
+    def from_json(cls, data: Dict) -> "MeshProfilingResult":
+        r = cls()
+        for kind in ("dot",) + COLLECTIVE_KINDS:
+            d = {}
+            for k, v in data.get(kind, {}).items():
+                d[tuple(json.loads(k))] = [tuple(p) for p in v]
+            setattr(r, f"{kind}_cost_dict", d)
+        return r
 
 
 class ProfilingResultDatabase:
@@ -64,54 +147,157 @@ class ProfilingResultDatabase:
     def query(self, cluster_key: str) -> Optional[MeshProfilingResult]:
         return self.data.get(cluster_key)
 
+    def best_result(self) -> Optional[MeshProfilingResult]:
+        """Any-mesh fallback: the entry with the most dot samples."""
+        best = None
+        for res in self.data.values():
+            n = sum(len(v) for v in res.dot_cost_dict.values())
+            if best is None or n > best[0]:
+                best = (n, res)
+        return best[1] if best else None
+
     def update_one_mesh(self, cluster_key: str,
                         result: MeshProfilingResult):
         self.data[cluster_key] = result
 
     def save(self, filename: str):
-        with open(filename, "wb") as f:
-            pickle.dump(self.data, f)
+        with open(filename, "w", encoding="utf-8") as f:
+            json.dump({k: v.to_json() for k, v in self.data.items()}, f,
+                      indent=1)
 
     @classmethod
     def load(cls, filename: str) -> "ProfilingResultDatabase":
-        with open(filename, "rb") as f:
-            return cls(pickle.load(f))
+        with open(filename, encoding="utf-8") as f:
+            raw = json.load(f)
+        return cls({k: MeshProfilingResult.from_json(v)
+                    for k, v in raw.items()})
+
+
+# ---- global calibration ----
+_global_calibration: Optional[CalibratedCostModel] = None
+_calibration_explicit = False
+_calibration_loaded_from: Optional[str] = None
+
+
+def calibration_from_file(fname: str) -> Optional[CalibratedCostModel]:
+    """Load + fit a profiling DB file; None (with a warning) on failure."""
+    try:
+        res = ProfilingResultDatabase.load(fname).best_result()
+        if res is None:
+            return None
+        cal = res.fit()
+        logger.info("loaded profiling DB %s (sec/flop@1T=%.3e)", fname,
+                    cal.sec_per_flop())
+        return cal
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning("loading profiling DB %s failed: %s", fname, e)
+        return None
+
+
+def set_global_calibration(model: Optional[CalibratedCostModel]):
+    global _global_calibration, _calibration_explicit
+    _global_calibration = model
+    _calibration_explicit = True
+
+
+def get_global_calibration() -> Optional[CalibratedCostModel]:
+    """The process-wide calibration from
+    ``global_config.profiling_database_filename`` (re-read whenever the
+    configured filename changes, so setting the flag after meshes were
+    already created still takes effect) unless set explicitly."""
+    global _global_calibration, _calibration_loaded_from
+    if _calibration_explicit:
+        return _global_calibration
+    from alpa_tpu.global_env import global_config
+    fname = global_config.profiling_database_filename
+    if fname != _calibration_loaded_from:
+        _calibration_loaded_from = fname
+        _global_calibration = calibration_from_file(fname) if fname else None
+    return _global_calibration
 
 
 def profile_one_mesh(physical_mesh,
-                     sizes=(1 << 16, 1 << 20, 1 << 24)) -> MeshProfilingResult:
+                     sizes=(1 << 16, 1 << 20, 1 << 23),
+                     dot_ns=(512, 1024, 2048, 4096),
+                     dtype=None) -> MeshProfilingResult:
     """Measure matmul + collective times on a live mesh
     (ref profile_one_hlo_op:392, simplified: jit-timed instead of
-    while-loop executables)."""
+    while-loop executables).  Collectives run as explicit shard_map
+    lax collectives so the measured op is exactly the modeled one.
+
+    Stays inside small shapes (largest dot: 4096^2 bf16 = 32 MB/operand)
+    so the remote-chip safe envelope is respected.
+    """
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
 
     result = MeshProfilingResult()
-    mesh = physical_mesh.get_jax_mesh(("x",),
-                                      (physical_mesh.num_devices,))
-    # dots
-    for n in (1024, 4096):
-        a = jnp.zeros((n, n), jnp.bfloat16)
+    n_dev = physical_mesh.num_devices
+    dtype = dtype or (jnp.bfloat16
+                      if physical_mesh.flat_devices[0].platform
+                      in ("tpu", "axon") else jnp.float32)
+
+    # dots: a ladder of sizes so MXU efficiency vs size is captured
+    for n in dot_ns:
+        a = jnp.asarray(np.random.RandomState(0).randn(n, n), dtype)
         f = jax.jit(lambda a: a @ a)
-        cost = benchmark_func(lambda: jax.block_until_ready(f(a)),
-                              warmup=1, repeat=2, number=3).mean()
-        result.record("dot", ("bf16",), 2 * n**3, cost)
-    # collectives
-    if physical_mesh.num_devices > 1:
+        sec = benchmark_func(lambda: jax.block_until_ready(f(a)),
+                             warmup=2, repeat=2, number=5).min()
+        result.record("dot", (np.dtype(dtype).name,), 2.0 * n**3, sec)
+
+    if n_dev > 1:
+        mesh = physical_mesh.get_logical_mesh((n_dev,)).get_jax_mesh(("x",))
+
+        def _time(fn, x):
+            f = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("x"),
+                                  out_specs=fn.out_specs,
+                                  check_rep=False))
+            return benchmark_func(
+                lambda: jax.block_until_ready(f(x)),
+                warmup=2, repeat=2, number=5).min()
+
+        n = n_dev
         for size in sizes:
+            # multiple of n*n so P("x") sharding and the all_to_all
+            # reshape(n, -1) divide evenly on any device count
+            elems = -(-max(size // 4, n * n) // (n * n)) * (n * n)
             x = jax.device_put(
-                jnp.zeros((size // 4,), jnp.float32),
+                jnp.zeros((elems,), jnp.float32),
                 NamedSharding(mesh, P("x")))
+            nbytes = float(elems * 4)
+
+            def ar(x):
+                return jax.lax.psum(x, "x")
+            ar.out_specs = P("x")
 
             def ag(x):
-                return jax.lax.with_sharding_constraint(
-                    x, NamedSharding(mesh, P()))
+                return jax.lax.all_gather(x, "x", tiled=True)
+            ag.out_specs = P()
 
-            f = jax.jit(ag)
-            cost = benchmark_func(lambda: jax.block_until_ready(f(x)),
-                                  warmup=1, repeat=2, number=3).mean()
-            result.record("all_gather", ("f32",), size, cost)
+            def rs(x):
+                return jax.lax.psum_scatter(x, "x", tiled=True)
+            rs.out_specs = P("x")
+
+            def a2a(x):
+                y = x.reshape(n, -1)
+                return jax.lax.all_to_all(y, "x", 0, 0, tiled=True)
+            a2a.out_specs = P("x")
+
+            # x-values are the effective wire bytes multiplying beta in
+            # LogicalDeviceMesh's cost formulas, so fitted (alpha, beta)
+            # transfer across axis sizes.  Per-device block = nbytes / n.
+            ring = (n - 1) / n
+            block = nbytes / n
+            result.record("all_reduce", ("f32", n), 2 * ring * block,
+                          _time(ar, x))
+            result.record("all_gather", ("f32", n), ring * nbytes,
+                          _time(ag, x))
+            result.record("reduce_scatter", ("f32", n), ring * block,
+                          _time(rs, x))
+            result.record("all_to_all", ("f32", n), ring * block,
+                          _time(a2a, x))
     return result
 
 
@@ -120,7 +306,8 @@ def profile_all(cluster, filename: Optional[str] = None
     """Profile the whole cluster (ref profile_all:725)."""
     db = ProfilingResultDatabase()
     mesh = cluster.get_physical_mesh()
-    key = f"{mesh.num_hosts}x{mesh.num_devices_per_host}"
+    key = (f"{mesh.num_hosts}x{mesh.num_devices_per_host}-"
+           f"{mesh.flat_devices[0].platform}")
     db.update_one_mesh(key, profile_one_mesh(mesh))
     if filename:
         db.save(filename)
@@ -135,24 +322,32 @@ def profile_all(cluster, filename: Optional[str] = None
 def estimate_stage_cost(stage_comps,
                         logical_mesh: LogicalDeviceMesh,
                         as_option,
-                        sec_per_flop: float = DEFAULT_SEC_PER_FLOP,
+                        sec_per_flop: Any = None,
                         use_ilp: bool = True) -> float:
     """Estimate execution time of a merged stage on a logical mesh.
 
-    compute = total flops / (devices * peak); communication = the intra-op
-    strategy graph's solved ILP objective (the same alpha-beta units scaled
-    into seconds).  This replaces the reference's compile-and-profile
-    workers as the default path (HloCostModelProfileWorker analog).
+    compute = total flops * sec/flop / devices; communication = the
+    intra-op strategy graph's solved ILP objective.  With a calibration
+    (``sec_per_flop`` callable / calibrated logical mesh) both terms are
+    real seconds; otherwise abstract units with a fixed exchange rate.
+    This replaces the reference's compile-and-profile workers as the
+    default path (HloCostModelProfileWorker analog).
     """
-    import jax
-    from jax._src.core import jaxpr_as_fun
-
     from alpa_tpu.pipeline_parallel.computation import merge_computations
 
     comp = (merge_computations(stage_comps, "cost_probe")
             if len(stage_comps) > 1 else stage_comps[0])
     flops = sum(jaxpr_eqn_flops(e) for e in comp.eqns)
     n_dev = logical_mesh.num_devices
+
+    if sec_per_flop is None:
+        cal = get_global_calibration()
+        if cal is not None:
+            sec_per_flop = cal.sec_per_flop(flops / max(n_dev, 1))
+        else:
+            sec_per_flop = DEFAULT_SEC_PER_FLOP
+    elif callable(sec_per_flop):
+        sec_per_flop = sec_per_flop(flops / max(n_dev, 1))
     compute_cost = flops * sec_per_flop / max(n_dev, 1)
 
     comm_cost = 0.0
@@ -162,13 +357,17 @@ def estimate_stage_cost(stage_comps,
                                                      solve_strategy_graph)
             from alpa_tpu.shard_parallel.strategy import build_strategy_graph
             closed = comp.closed_jaxpr()
-            graph = build_strategy_graph(closed, [v.aval for v in comp.invars],
+            graph = build_strategy_graph(closed,
+                                         [v.aval for v in comp.invars],
                                          logical_mesh, [], as_option)
             choice = solve_strategy_graph(graph, time_limit=10)
-            # alpha-beta units: beta=0.01 ~ 1 byte / (ICI ~100GB/s) scaled;
-            # treat one cost unit as 1e-7 s (relative ranking is what
-            # matters to the DP).
-            comm_cost = solution_cost(graph, choice) * 1e-7
+            units = solution_cost(graph, choice)
+            if logical_mesh.calibrated:
+                comm_cost = units  # already seconds
+            else:
+                # abstract alpha-beta units: fixed exchange rate (relative
+                # ranking is what matters to the DP without a calibration)
+                comm_cost = units * 1e-7
         except Exception as e:  # pylint: disable=broad-except
             logger.debug("stage ILP cost estimate failed: %s", e)
     return compute_cost + comm_cost
@@ -177,7 +376,6 @@ def estimate_stage_cost(stage_comps,
 def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
                           num_in_flight: int = 1) -> float:
     """Rough per-device bytes: params/devices + activations in flight."""
-    comp = stage_comps[0] if len(stage_comps) == 1 else None
     comps = stage_comps
     param_bytes = 0.0
     act_bytes = 0.0

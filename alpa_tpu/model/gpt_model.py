@@ -50,6 +50,8 @@ class GPTConfig:
     # HF GPT-2 uses 1e-5 (transformers layer_norm_epsilon); flax default
     # 1e-6 makes HF-loaded weights diverge slightly
     layer_norm_eps: float = 1e-5
+    # rematerialize each transformer block (training memory <-> flops)
+    remat_blocks: bool = False
     # decoder (causal) vs encoder (bidirectional, BERT-style)
     causal: bool = True
 
@@ -186,7 +188,10 @@ class GPTModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, kv_caches=None,
-                 deterministic=True):
+                 deterministic=True, return_hidden=False):
+        """``return_hidden=True`` returns the final (B, S, H) hidden states
+        instead of logits, for a fused/chunked lm-head + loss (see
+        model_util.chunked_cross_entropy_loss)."""
         cfg = self.config
         b, s = input_ids.shape
         if position_ids is None:
@@ -196,18 +201,24 @@ class GPTModel(nn.Module):
         x = tok_emb(input_ids)
         x = x + nn.Embed(cfg.seq_len, cfg.hidden_size, dtype=cfg.dtype,
                          name="wpe")(position_ids)
+        block_cls = TransformerBlock
+        if cfg.remat_blocks and kv_caches is None:
+            block_cls = nn.remat(TransformerBlock,
+                                 static_argnums=(2, 3))
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
             if (cfg.pipeline_boundary_every and i > 0 and
                     i % cfg.pipeline_boundary_every == 0):
                 mark_pipeline_boundary()
             cache_i = kv_caches[i] if kv_caches is not None else None
-            x, new_cache = TransformerBlock(cfg, name=f"h{i}")(
+            x, new_cache = block_cls(cfg, name=f"h{i}")(
                 x, cache_i, deterministic)
             if new_caches is not None:
                 new_caches.append(new_cache)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
+        if return_hidden:
+            return x
         if cfg.tie_embeddings:
             logits = tok_emb.attend(x.astype(cfg.dtype))
         else:

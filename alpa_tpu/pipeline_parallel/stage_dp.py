@@ -192,23 +192,17 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             "searched by the intra-op planner, not here",
             stage_option.submesh_logical_shape_space)
 
-    # Calibrate seconds/flop from a profiling DB if one is given
-    # (ref ProfilingResultDatabase path).
-    sec_per_flop = None
-    db_file = getattr(stage_option, "profiling_database_filename", None) or \
-        global_config.profiling_database_filename
-    if db_file:
-        try:
-            from alpa_tpu.mesh_profiling import ProfilingResultDatabase
-            db = ProfilingResultDatabase.load(db_file)
-            for res in db.data.values():
-                for key, points in res.dot_cost_dict.items():
-                    flop, sec = points[-1]
-                    sec_per_flop = sec / flop
-                    break
-                break
-        except Exception as e:  # pylint: disable=broad-except
-            logger.warning("loading profiling DB %s failed: %s", db_file, e)
+    # Calibrate from a profiling DB (ref ProfilingResultDatabase path):
+    # an explicit per-option DB wins, else the process-global one
+    # (global_config.profiling_database_filename).  The fit supplies
+    # size-dependent sec/flop and per-collective alpha-beta in real
+    # seconds, so the DP's decisions trace back to measurements.
+    from alpa_tpu.mesh_profiling import (calibration_from_file,
+                                         get_global_calibration)
+    db_file = getattr(stage_option, "profiling_database_filename", None)
+    cal = calibration_from_file(db_file) if db_file else None
+    if cal is None:
+        cal = get_global_calibration()
 
     use_ilp_cost = not getattr(stage_option, "use_hlo_cost_model", True) or \
         (L * L * M <= 256)
@@ -222,13 +216,14 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
         shape = (h * d, 1) if h == 1 else (h, d)
         logical = LogicalDeviceMesh(
             None, np.arange(h * d).reshape(shape),
-            mesh_beta=(0.1 if h > 1 else 0.01, 0.01))
+            mesh_beta=(0.1 if h > 1 else 0.01, 0.01),
+            calibration=cal)
         for i in range(L):
             for j in range(i, L):
                 comps = layer_comps[i:j + 1]
                 kwargs = {"use_ilp": use_ilp_cost}
-                if sec_per_flop is not None:
-                    kwargs["sec_per_flop"] = sec_per_flop
+                if cal is not None:
+                    kwargs["sec_per_flop"] = cal.sec_per_flop
                 costs[i, j, m] = estimate_stage_cost(
                     comps, logical, auto_sharding_option, **kwargs)
                 if mem_budget > 0:

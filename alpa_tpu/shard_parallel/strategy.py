@@ -196,26 +196,20 @@ def flatten_jaxpr_eqns(jaxpr: Jaxpr, env: Optional[dict] = None,
     over substituted vars.  Scan/while/cond are left opaque (barriers).
 
     ``info`` (optional dict) collects side data for re-evaluation:
-    ``captured_consts`` (inner constvar -> value), ``has_remat`` (whether a
-    checkpoint boundary was inlined away), and ``env`` (the substitution,
-    for resolving outer outvars of inlined calls).
+    ``captured_consts`` (inner constvar -> value) and ``env`` (the
+    substitution, for resolving outer outvars of inlined calls).
     """
     env = env if env is not None else {}
     if info is not None:
         info.setdefault("captured_consts", {})
-        info.setdefault("has_remat", False)
         if depth == 0:
             # only the top-level substitution maps outer outvars; inner
             # envs must not clobber it
             info["env"] = env
     out = []
     for eqn in jaxpr.eqns:
-        prim = eqn.primitive.name
         site = _inline_site(eqn, depth)
         if site is not None:
-            if info is not None and prim in ("remat", "checkpoint",
-                                             "remat2"):
-                info["has_remat"] = True
             sub_jaxpr, consts = site
             inner_env = {}
             outer_in = [_subst(v, env) for v in eqn.invars]
@@ -862,7 +856,6 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
     sub_env = flatten_info.get("env", {})
     graph.outvars = [_subst(v, sub_env) for v in jaxpr.outvars]
     graph.captured_consts = flatten_info.get("captured_consts", {})
-    graph.has_remat = flatten_info.get("has_remat", False)
     return graph
 
 

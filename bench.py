@@ -67,8 +67,12 @@ def main():
 
     if on_tpu:
         # GPT-125M-class config in bf16; batch sized for one v5e chip.
+        # Flash attention + per-block remat + chunked lm-head loss keep the
+        # working set small (the fp32 logits alone would be 1.6 GB).
         config = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
-                           seq_len=1024, vocab_size=51200, dtype=jnp.bfloat16)
+                           seq_len=1024, vocab_size=51200,
+                           dtype=jnp.bfloat16, attention_impl="flash",
+                           remat_blocks=True)
         batch_size = 8
     else:
         config = GPTConfig(hidden_size=256, num_layers=4, num_heads=8,
@@ -88,11 +92,19 @@ def main():
     state = train_state.TrainState.create(apply_fn=model.apply, params=params,
                                           tx=tx)
 
+    from alpa_tpu.model.model_util import chunked_cross_entropy_loss
+
     @alpa_tpu.parallelize(method=alpa_tpu.ShardParallel(),
                           donate_argnums=(0,))
     def train_step(state, batch):
 
         def loss_fn(p):
+            if config.tie_embeddings:
+                hidden = state.apply_fn(p, batch["input_ids"],
+                                        return_hidden=True)
+                emb = p["params"]["wte"]["embedding"]
+                return chunked_cross_entropy_loss(hidden, emb,
+                                                  batch["labels"])
             logits = state.apply_fn(p, batch["input_ids"])
             return cross_entropy_loss(logits.astype(jnp.float32),
                                       batch["labels"])

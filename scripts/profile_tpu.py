@@ -1,0 +1,42 @@
+"""Profile the real TPU chip into prof_database_tpu.json (safe envelope).
+
+Runs the measurement in a child process with a hard timeout so a wedged
+relay cannot hang the caller (same guard as bench.py).  Stays inside the
+known-safe shape envelope: largest dot is 4096^2 bf16 (32 MB/operand).
+
+Usage:  PYTHONPATH=/root/repo:/root/.axon_site python scripts/profile_tpu.py
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "prof_database_tpu.json")
+
+
+def inner():
+    import alpa_tpu
+    from alpa_tpu.device_mesh import get_global_cluster
+    from alpa_tpu.mesh_profiling import profile_all
+
+    alpa_tpu.init("local")
+    db = profile_all(get_global_cluster(), OUT)
+    for key, res in db.data.items():
+        cal = res.fit()
+        print(f"{key}: sec/flop@1e12={cal.sec_per_flop(1e12):.3e} "
+              f"({1.0 / cal.sec_per_flop(1e12) / 1e12:.1f} TFLOPS)")
+    print(f"saved {OUT}")
+
+
+if __name__ == "__main__":
+    if "--inner" in sys.argv:
+        inner()
+        sys.exit(0)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            timeout=600)
+        sys.exit(r.returncode)
+    except subprocess.TimeoutExpired:
+        print("TPU profiling timed out (relay wedged?); no DB written")
+        sys.exit(1)
