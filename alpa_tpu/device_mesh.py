@@ -77,7 +77,12 @@ class LogicalDeviceMesh:
 
     @property
     def calibrated(self) -> bool:
-        return self.calibration is not None
+        """True only when collective costs come back in real seconds.
+        A dot-only calibration (e.g. profiled on a single chip) must NOT
+        count: estimate_stage_cost would read abstract alpha-beta units
+        as seconds and inflate comm costs ~1e7x."""
+        return (self.calibration is not None and
+                bool(self.calibration.collective_ab))
 
     def _ab(self, kind: str, mesh_dim: int):
         """(alpha, beta, tie) for one collective kind on one axis.  The
@@ -86,12 +91,16 @@ class LogicalDeviceMesh:
         the tie is dropped.  The calibration is measured on the fast
         (intra-host/ICI) fabric; a slower axis (higher abstract beta,
         e.g. DCN) scales the measured beta by the abstract ratio so the
-        cross-host penalty survives calibration."""
-        if self.calibration is not None:
+        cross-host penalty survives calibration.  A kind that was not
+        measured borrows the most expensive measured kind's fit so every
+        cost query stays in one unit system (seconds)."""
+        if self.calibrated:
             ab = self.calibration.alpha_beta(kind)
-            if ab is not None:
-                ratio = self.mesh_beta[mesh_dim] / min(self.mesh_beta)
-                return ab[0], ab[1] * ratio, 0.0
+            if ab is None:
+                ab = max(self.calibration.collective_ab.values(),
+                         key=lambda p: p[1])
+            ratio = self.mesh_beta[mesh_dim] / min(self.mesh_beta)
+            return ab[0], ab[1] * ratio, 0.0
         ties = {"all_gather": 0.1, "all_reduce": 0.01,
                 "reduce_scatter": 0.001, "all_to_all": 0.001}
         return (self.mesh_alpha[mesh_dim], self.mesh_beta[mesh_dim],
@@ -332,8 +341,9 @@ class VirtualPhysicalMesh:
         if mesh_beta is None:
             mesh_beta = tuple([0.1 if (self.num_hosts > 1 and i == 0) else 0.01
                                for i in range(len(mesh_shape))])
-        lm = LogicalDeviceMesh(phys, id_mesh, mesh_alpha, mesh_beta)
-        return lm
+        from alpa_tpu.mesh_profiling import get_global_calibration
+        return LogicalDeviceMesh(phys, id_mesh, mesh_alpha, mesh_beta,
+                                 calibration=get_global_calibration())
 
     def get_physical_mesh(self) -> PhysicalDeviceMesh:
         """Bind to real devices (ref :1940)."""

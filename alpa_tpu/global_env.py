@@ -38,7 +38,7 @@ class GlobalConfig:
         # construction (ref: HloCostModelProfileWorker path).  Default True on
         # TPU: spinning up submeshes to profile is slow (SURVEY.md hard part 2).
         self.use_hlo_cost_model = _env_bool("ALPA_TPU_USE_HLO_COST_MODEL", True)
-        # Path to a pickled ProfilingResultDatabase.
+        # Path to a JSON ProfilingResultDatabase (mesh_profiling.profile_all).
         self.profiling_database_filename = os.environ.get(
             "ALPA_TPU_PROF_DATABASE", None)
         # Time limit (seconds) handed to the ILP solver.
@@ -50,6 +50,14 @@ class GlobalConfig:
         # Cross-mesh resharding strategy: "send_recv" | "broadcast".
         # (ref: global_config.resharding_mode)
         self.resharding_mode = os.environ.get("ALPA_TPU_RESHARDING_MODE", "send_recv")
+        # How RESHARD instructions move data: "device_put" lets the jax
+        # runtime carry the transfer; "planned" drives the tile plan
+        # literally (per-tile routed transfers + local-allgather /
+        # broadcast legs, ref SymbolicReshardingTask :418) with byte
+        # accounting.  "planned" is the validating mode; device_put is the
+        # production fast path.
+        self.resharding_execution = os.environ.get(
+            "ALPA_TPU_RESHARDING_EXEC", "device_put")
         # Load-balancing mode for resharding send selection:
         # "normal" | "no_loadbalance".
         self.resharding_loadbalance_mode = os.environ.get(

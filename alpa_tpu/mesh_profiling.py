@@ -51,13 +51,15 @@ class CalibratedCostModel:
     dot_points: List[Tuple[float, float]]
     collective_ab: Dict[str, Tuple[float, float]]
 
-    def sec_per_flop(self, flops: float = 1e12) -> float:
-        if not self.dot_points:
-            return DEFAULT_SEC_PER_FLOP
+    def __post_init__(self):
         pts = sorted(self.dot_points)
-        xs = np.array([p[0] for p in pts], float)
-        ys = np.array([p[1] for p in pts], float)
-        return float(np.interp(flops, xs, ys))
+        self._dot_xs = np.array([p[0] for p in pts], float)
+        self._dot_ys = np.array([p[1] for p in pts], float)
+
+    def sec_per_flop(self, flops: float = 1e12) -> float:
+        if not len(self._dot_xs):
+            return DEFAULT_SEC_PER_FLOP
+        return float(np.interp(flops, self._dot_xs, self._dot_ys))
 
     def alpha_beta(self, kind: str) -> Optional[Tuple[float, float]]:
         return self.collective_ab.get(kind)
@@ -210,8 +212,19 @@ def get_global_calibration() -> Optional[CalibratedCostModel]:
         return _global_calibration
     from alpa_tpu.global_env import global_config
     fname = global_config.profiling_database_filename
-    if fname != _calibration_loaded_from:
-        _calibration_loaded_from = fname
+    # Cache key includes the file identity (ns mtime + size) so a DB
+    # written later to the same path (e.g. profile_all saving to the
+    # configured filename in this process) is picked up instead of the
+    # stale/failed first load.
+    try:
+        import os
+        st = os.stat(fname) if fname else None
+        ident = (st.st_mtime_ns, st.st_size) if st else None
+    except OSError:
+        ident = None
+    key = (fname, ident)
+    if key != _calibration_loaded_from:
+        _calibration_loaded_from = key
         _global_calibration = calibration_from_file(fname) if fname else None
     return _global_calibration
 

@@ -133,6 +133,12 @@ class ReshardingTaskSpec:
     # whether the local-allgather rewrite applies (dst replicated axes
     # served by intra-mesh collectives instead of repeated sends)
     allgather_rewrite: bool = False
+    # device ids aligned with shard indexes (source / destination VDAs),
+    # so an executor can route each planned TileSlice to real devices
+    src_device_ids: Tuple[int, ...] = ()
+    dst_device_ids: Tuple[int, ...] = ()
+    # per destination shard, the FULL tile it must end up holding
+    dst_tiles: Tuple[Tile, ...] = ()
 
     def total_tiles(self):
         return sum(len(r.srcs) for r in self.requests)
@@ -217,7 +223,10 @@ def plan_resharding(shape: Tuple[int, ...],
             total += sum(s.tile.size for s in srcs) * itemsize
 
     return ReshardingTaskSpec(tuple(shape), requests, total,
-                              allgather_rewrite)
+                              allgather_rewrite,
+                              src_device_ids=tuple(src_vda.device_ids),
+                              dst_device_ids=tuple(dst_vda.device_ids),
+                              dst_tiles=tuple(dst_vda.device_tiles))
 
 
 def naive_transfer_bytes(shape, itemsize, dst_sharding) -> float:
@@ -231,24 +240,159 @@ def naive_transfer_bytes(shape, itemsize, dst_sharding) -> float:
 # execution
 ########################################
 
+_warned_fallback = False
+
+
+@dataclasses.dataclass
+class ExecutionReport:
+    """Bytes actually moved by one ``ReshardingTask.run`` call.
+
+    ``cross_mesh_bytes`` is the inter-mesh traffic (the DCN-class hop the
+    planner minimizes); ``intra_mesh_bytes`` is destination-internal
+    movement (the ICI-class all-gather/broadcast leg).  Tests assert
+    ``cross_mesh_bytes == spec.transfer_bytes``."""
+    mode: str = "device_put"
+    cross_mesh_bytes: float = 0.0
+    intra_mesh_bytes: float = 0.0
+    n_tiles: int = 0
+
 
 class ReshardingTask:
     """Executable resharding (ref SymbolicReshardingTask :418).
 
-    Execution delegates the data movement to ``jax.device_put``, whose
-    runtime performs shard-level transfers between the meshes; the spec is
-    the *plan* — it predicts and accounts the bytes that must cross
-    (tests assert the coverage/byte math) and drives the
-    ``get_resharding_report`` accounting.  Driving per-tile transfers
-    explicitly (to force the planned routing on DCN) is the designed
-    extension point once multi-slice hardware is available to validate
-    against.
+    Three execution modes:
+
+    - ``device_put`` (default fast path): one ``jax.device_put`` — the jax
+      runtime carries shard transfers over ICI/DCN itself.  The spec is
+      used for accounting only.
+    - ``tiled`` (ref send/recv mode :418): drives the plan literally —
+      each planned ``TileSlice`` is sliced out *on its source device*,
+      transferred to its destination device, and the destination tiles are
+      assembled in place.  When the plan carries the local-allgather
+      rewrite, each replica-group member receives only its 1/k part
+      cross-mesh and the full tile is completed by intra-destination
+      transfers (the ICI gather leg).
+    - ``broadcast`` (ref broadcast mode :935): each unique destination
+      tile crosses the mesh boundary exactly once (to its first holder),
+      then fans out to the other replica holders inside the destination
+      mesh.
+
+    ``last_report`` records the bytes each leg actually moved so tests can
+    hold execution to the plan's accounting.
     """
 
-    def __init__(self, spec: ReshardingTaskSpec, dst_sharding):
+    def __init__(self, spec: ReshardingTaskSpec, dst_sharding,
+                 mode: str = "device_put"):
         self.spec = spec
         self.dst_sharding = dst_sharding
+        self.mode = mode
+        self.last_report: Optional[ExecutionReport] = None
 
-    def run(self, src_array):
+    def run(self, src_array, mode: Optional[str] = None):
         import jax
-        return jax.device_put(src_array, self.dst_sharding)
+        mode = mode or self.mode
+        if mode == "device_put" or not self.spec.requests:
+            self.last_report = ExecutionReport(mode="device_put")
+            return jax.device_put(src_array, self.dst_sharding)
+        if mode not in ("tiled", "broadcast"):
+            raise ValueError(f"unknown resharding execution mode: {mode}")
+        addressable_src = {s.device.id for s in src_array.addressable_shards}
+        addressable_dst = {d.id
+                           for d in self.dst_sharding.addressable_devices}
+        if (not set(self.spec.src_device_ids) <= addressable_src or
+                not set(self.spec.dst_device_ids) <= addressable_dst):
+            # Planned modes drive transfers from the controller and need
+            # every source/destination shard addressable; on a multi-host
+            # run fall back to the runtime-carried transfer.
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                logger.warning(
+                    "planned resharding execution needs all shards "
+                    "addressable from this process; falling back to "
+                    "device_put (warned once)")
+            self.last_report = ExecutionReport(mode="device_put")
+            return jax.device_put(src_array, self.dst_sharding)
+        return self._run_planned(src_array, broadcast=(mode == "broadcast"))
+
+    # -- planned execution --------------------------------------------
+
+    def _run_planned(self, src_array, broadcast: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        spec = self.spec
+        itemsize = src_array.dtype.itemsize
+        report = ExecutionReport(mode="broadcast" if broadcast else "tiled")
+
+        src_data = {s.device.id: s.data
+                    for s in src_array.addressable_shards}
+        dev_by_id = {d.id: d for d in self.dst_sharding.device_set}
+        for d in getattr(src_array.sharding, "device_set", ()):
+            dev_by_id.setdefault(d.id, d)
+
+        # Replica groups: destination shards holding the same full tile.
+        groups = VirtualDistributedArray(
+            spec.shape, list(spec.dst_tiles),
+            list(spec.dst_device_ids)).unique_tiles
+
+        # 1) cross-mesh leg: move each planned TileSlice to one dst device.
+        #    landed[shard_index] = [(global_tile, piece_on_dst_device)]
+        landed: Dict[int, List[Tuple[Tile, Any]]] = {}
+        seen_at: Dict[int, set] = {}
+        for req in spec.requests:
+            holders = groups[spec.dst_tiles[req.dst_shard_index].slices]
+            # broadcast mode: every cross-mesh fetch of a replica group is
+            # routed to the group's first holder (each unique piece crosses
+            # once); other holders are served by intra-mesh fan-out below.
+            target = holders[0] if broadcast else req.dst_shard_index
+            dst_dev = dev_by_id[spec.dst_device_ids[target]]
+            for ts in req.srcs:
+                if ts.tile.slices in seen_at.setdefault(target, set()):
+                    continue
+                seen_at[target].add(ts.tile.slices)
+                shard = src_data[spec.src_device_ids[ts.src_shard_index]]
+                piece = shard[tuple(slice(a, b)
+                                    for a, b in ts.offset_in_src)]
+                moved = jax.device_put(piece, dst_dev)
+                report.cross_mesh_bytes += ts.tile.size * itemsize
+                report.n_tiles += 1
+                landed.setdefault(target, []).append((ts.tile, moved))
+
+        # 2) intra-mesh leg + assembly: every dst shard assembles its FULL
+        #    tile; pieces that landed on a sibling replica are pulled over
+        #    the destination mesh's own links (allgather / broadcast leg).
+        out_arrays = []
+        for shard_i, full_tile in enumerate(spec.dst_tiles):
+            dst_dev = dev_by_id[spec.dst_device_ids[shard_i]]
+            holders = groups[full_tile.slices]
+            if spec.allgather_rewrite or broadcast:
+                donors = holders          # union of the group's pieces
+            else:
+                donors = [shard_i]        # own fetches cover the tile
+            pieces: List[Tuple[Tile, Any]] = []
+            covered: Dict[Tuple, Any] = {}
+            for d in donors:
+                for tile, buf in landed.get(d, ()):
+                    if tile.slices in covered:
+                        continue
+                    if d != shard_i:
+                        buf = jax.device_put(buf, dst_dev)
+                        report.intra_mesh_bytes += tile.size * itemsize
+                    covered[tile.slices] = buf
+                    pieces.append((tile, buf))
+            if len(pieces) == 1 and pieces[0][0].slices == full_tile.slices:
+                tile_arr = pieces[0][1]
+            else:
+                tile_arr = jax.device_put(
+                    jnp.zeros(full_tile.shape, src_array.dtype), dst_dev)
+                for tile, buf in pieces:
+                    starts = tuple(a for a, _b in tile.offset_in(full_tile))
+                    tile_arr = lax.dynamic_update_slice(
+                        tile_arr, buf, starts)
+            out_arrays.append(tile_arr)
+
+        self.last_report = report
+        return jax.make_array_from_single_device_arrays(
+            spec.shape, self.dst_sharding, out_arrays)

@@ -338,6 +338,8 @@ class PipeshardDriverExecutable:
 
     def _emit(self):
         self._resharding_bytes = 0.0
+        self._executed_resharding_bytes = 0.0
+        self._executed_intra_mesh_bytes = 0.0
         ginvar_idx = {v: i for i, v in enumerate(self.global_invars)}
         batch_var = {
             v for v, b in zip(self.global_invars, self.batch_invars) if b
@@ -414,7 +416,12 @@ class PipeshardDriverExecutable:
                             tuple(v.aval.shape), v.aval.dtype.itemsize,
                             src_sh, dst_sharding)
                         self._resharding_bytes += inst.plan.transfer_bytes
-                    except Exception:  # pylint: disable=broad-except
+                    except Exception as e:  # pylint: disable=broad-except
+                        # the planned execution mode silently degrades to
+                        # device_put for this transfer — keep it visible
+                        logger.warning(
+                            "resharding plan for %s (%s -> mesh %d) "
+                            "failed: %s", v, exec_name, mesh_id, e)
                         inst.plan = None
                 instructions.append(inst)
                 location[key].add(mesh_id)
@@ -533,6 +540,15 @@ class PipeshardDriverExecutable:
     def _launch(self, *flat_args):
         env: Dict[Tuple[Var, int], Dict[int, Any]] = {}
         n_mb = self.num_micro_batches
+        # executed-resharding accounting is per step, comparable to the
+        # per-step planned bytes in get_resharding_report
+        self._executed_resharding_bytes = 0.0
+        self._executed_intra_mesh_bytes = 0.0
+        exec_mode = global_config.resharding_execution
+        if exec_mode not in ("device_put", "planned"):
+            raise ValueError(
+                "global_config.resharding_execution must be 'device_put' "
+                f"or 'planned', got {exec_mode!r}")
 
         # place global inputs
         for v, places in self.input_place.items():
@@ -612,8 +628,26 @@ class PipeshardDriverExecutable:
                     tracer.log("RUN", inst.info)
             elif inst.opcode == PipelineInstType.RESHARD:
                 val = env[inst.var_key][inst.src_mesh]
-                env[inst.var_key][inst.dst_mesh] = jax.device_put(
-                    val, inst.dst_sharding)
+                if (exec_mode == "planned" and inst.src_mesh != inst.dst_mesh
+                        and inst.plan is not None):
+                    # Drive the tile plan literally (per-tile routed
+                    # transfers; send_recv or broadcast leg choice from
+                    # global_config.resharding_mode, ref :418/:935).
+                    if inst.task is None:
+                        from alpa_tpu.pipeline_parallel. \
+                            cross_mesh_resharding import ReshardingTask
+                        inst.task = ReshardingTask(inst.plan,
+                                                   inst.dst_sharding)
+                    mode = ("broadcast" if global_config.resharding_mode ==
+                            "broadcast" else "tiled")
+                    env[inst.var_key][inst.dst_mesh] = inst.task.run(
+                        val, mode)
+                    rep = inst.task.last_report
+                    self._executed_resharding_bytes += rep.cross_mesh_bytes
+                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
+                else:
+                    env[inst.var_key][inst.dst_mesh] = jax.device_put(
+                        val, inst.dst_sharding)
                 if collect:
                     tracer.log("RESHARD", inst.info)
             else:  # FREE
@@ -689,8 +723,14 @@ class PipeshardDriverExecutable:
         n = sum(1 for i in self.instructions
                 if i.opcode == PipelineInstType.RESHARD and
                 i.src_mesh != i.dst_mesh)
-        return (f"{n} cross-mesh transfers, "
-                f"{self._resharding_bytes / 1e6:.3f} MB per step (planned)")
+        report = (f"{n} cross-mesh transfers, "
+                  f"{self._resharding_bytes / 1e6:.3f} MB per step (planned)")
+        if self._executed_resharding_bytes:
+            report += (
+                f"; executed {self._executed_resharding_bytes / 1e6:.3f} MB "
+                f"cross-mesh + {self._executed_intra_mesh_bytes / 1e6:.3f} MB "
+                f"intra-mesh ({global_config.resharding_execution})")
+        return report
 
     def sync(self):
         self.mesh_group.sync_workers()

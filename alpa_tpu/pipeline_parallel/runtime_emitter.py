@@ -49,6 +49,10 @@ class PipelineInstruction:
     src_mesh: Optional[int] = None
     dst_mesh: Optional[int] = None
     dst_sharding: Any = None
+    # tile-level transfer plan (cross_mesh_resharding.ReshardingTaskSpec)
+    plan: Any = None
+    # cached executor for planned execution mode
+    task: Any = None
     # FREE
     free_keys: Optional[List[Tuple[int, int, int]]] = None  # (var,inst,mesh)
     info: str = ""

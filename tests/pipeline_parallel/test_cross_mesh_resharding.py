@@ -103,5 +103,147 @@ class TestPlanning:
         assert y.sharding.is_equivalent_to(dst, 2)
 
 
+class TestPlannedExecution:
+    """The executor drives the plan literally: executed cross-mesh bytes
+    must equal the spec's accounting (VERDICT r1 next#5; ref
+    SymbolicReshardingTask :418 send/recv + :935 broadcast)."""
+
+    def _src_dst(self):
+        src_mesh = _mesh(4)
+        dst_mesh = Mesh(np.array(jax.devices()[4:8]), ("y",))
+        return src_mesh, dst_mesh
+
+    def test_tiled_bytes_match_plan(self):
+        src_mesh, dst_mesh = self._src_dst()
+        src = NamedSharding(src_mesh, P("x"))        # rows 4-way
+        dst = NamedSharding(dst_mesh, P(None, "y"))  # cols 4-way
+        x = jax.device_put(jnp.arange(64.0, dtype=jnp.float32)
+                           .reshape(8, 8), src)
+        spec = plan_resharding((8, 8), x.dtype.itemsize, src, dst,
+                               allow_allgather_rewrite=False)
+        task = ReshardingTask(spec, dst)
+        y = task.run(x, mode="tiled")
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        assert y.sharding.is_equivalent_to(dst, 2)
+        assert task.last_report.cross_mesh_bytes == spec.transfer_bytes
+        assert task.last_report.intra_mesh_bytes == 0
+
+    def test_allgather_rewrite_executes_fewer_cross_bytes(self):
+        src_mesh, dst_mesh = self._src_dst()
+        src = NamedSharding(src_mesh, P("x"))
+        dst = NamedSharding(dst_mesh, P())   # fully replicated dst
+        x = jax.device_put(jnp.arange(64.0, dtype=jnp.float32)
+                           .reshape(8, 8), src)
+        naive = plan_resharding((8, 8), 4, src, dst,
+                                allow_allgather_rewrite=False)
+        smart = plan_resharding((8, 8), 4, src, dst,
+                                allow_allgather_rewrite=True)
+        t_naive = ReshardingTask(naive, dst)
+        y1 = t_naive.run(x, mode="tiled")
+        t_smart = ReshardingTask(smart, dst)
+        y2 = t_smart.run(x, mode="tiled")
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(x))
+        np.testing.assert_array_equal(np.asarray(y2), np.asarray(x))
+        # executed bytes == planned bytes in both modes; the rewrite's
+        # cross-mesh leg is k=4x smaller, paid for by intra-mesh gather
+        assert t_naive.last_report.cross_mesh_bytes == naive.transfer_bytes
+        assert t_smart.last_report.cross_mesh_bytes == smart.transfer_bytes
+        assert (t_smart.last_report.cross_mesh_bytes * 4
+                <= t_naive.last_report.cross_mesh_bytes + 1e-6)
+        assert t_smart.last_report.intra_mesh_bytes > 0
+
+    def test_broadcast_mode_unique_tiles_cross_once(self):
+        src_mesh, dst_mesh = self._src_dst()
+        src = NamedSharding(src_mesh, P("x"))
+        dst = NamedSharding(dst_mesh, P())   # every dst device = full array
+        x = jax.device_put(jnp.arange(64.0, dtype=jnp.float32)
+                           .reshape(8, 8), src)
+        spec = plan_resharding((8, 8), 4, src, dst,
+                               allow_allgather_rewrite=False)
+        task = ReshardingTask(spec, dst)
+        y = task.run(x, mode="broadcast")
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        # the full array crosses exactly once (256 B), not once per replica
+        assert task.last_report.cross_mesh_bytes == 8 * 8 * 4
+        assert task.last_report.intra_mesh_bytes > 0
+
+    def test_scalar_transfer_accounted(self):
+        """0-d arrays (e.g. the loss) go through the planned path too —
+        executed bytes must match the plan, not silently report zero."""
+        src_mesh, dst_mesh = self._src_dst()
+        src = NamedSharding(src_mesh, P())
+        dst = NamedSharding(dst_mesh, P())
+        x = jax.device_put(jnp.float32(3.25), src)
+        spec = plan_resharding((), 4, src, dst)
+        task = ReshardingTask(spec, dst)
+        y = task.run(x, mode="tiled")
+        assert float(y) == 3.25
+        assert task.last_report.cross_mesh_bytes == spec.transfer_bytes
+        assert task.last_report.mode == "tiled"
+
+    def test_permuted_2d_dst_tiled(self):
+        src_mesh = _mesh(4, shape=(2, 2), names=("a", "b"))
+        dst_mesh = Mesh(np.array(jax.devices()[4:8]).reshape(2, 2),
+                        ("c", "d"))
+        src = NamedSharding(src_mesh, P("a", "b"))
+        dst = NamedSharding(dst_mesh, P("d", None))
+        x = jax.device_put(jnp.arange(96.0, dtype=jnp.float32)
+                           .reshape(8, 12), src)
+        spec = plan_resharding((8, 12), 4, src, dst)
+        task = ReshardingTask(spec, dst)
+        y = task.run(x, mode="tiled")
+        np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+        assert task.last_report.cross_mesh_bytes == spec.transfer_bytes
+
+
+class TestPipeshardPlannedExecution:
+    """End-to-end: a pipelined step under resharding_execution='planned'
+    matches the device_put fast path bit-for-bit and reports executed
+    bytes (SURVEY §4 strategy 5)."""
+
+    @pytest.mark.parametrize("mode", ["send_recv", "broadcast"])
+    def test_pipeshard_numerics_and_accounting(self, mode):
+        import alpa_tpu
+        from alpa_tpu import PipeshardParallel
+        from alpa_tpu.global_env import global_config
+        from alpa_tpu.pipeline_parallel.layer_construction import (
+            ManualLayerOption)
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            UniformStageOption)
+        from alpa_tpu.testing import (assert_allclose,
+                                      create_mlp_train_state_and_batch,
+                                      get_mlp_train_step)
+
+        alpa_tpu.init(cluster="local")
+        method = PipeshardParallel(
+            num_micro_batches=2, layer_option=ManualLayerOption(),
+            stage_option=UniformStageOption(num_stages=2))
+        state_p, batch = create_mlp_train_state_and_batch(
+            batch_size=64, num_layers=4, manual_pipeline_layer=True)
+        state_s, _ = create_mlp_train_state_and_batch(
+            batch_size=64, num_layers=4, manual_pipeline_layer=True)
+
+        old_exec = global_config.resharding_execution
+        old_mode = global_config.resharding_mode
+        global_config.resharding_execution = "planned"
+        global_config.resharding_mode = mode
+        try:
+            pstep = get_mlp_train_step(method, use_value_and_grad=True)
+            serial = get_mlp_train_step(None)
+            state_p, loss_p = pstep(state_p, batch)
+            state_s, loss_s = serial(state_s, batch)
+            ex = pstep.get_last_executable()
+            report = ex.get_resharding_report()
+        finally:
+            global_config.resharding_execution = old_exec
+            global_config.resharding_mode = old_mode
+        assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
+        assert_allclose(jax.device_get(state_s.params),
+                        jax.device_get(state_p.params), 2e-3, 2e-3)
+        if ex._resharding_bytes:
+            assert ex._executed_resharding_bytes > 0
+            assert "executed" in report
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
