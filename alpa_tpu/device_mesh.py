@@ -261,7 +261,10 @@ class PhysicalDeviceMesh:
     def sync_workers(self):
         """Block until all outstanding work on this mesh is done."""
         jax.effects_barrier()
-        (jax.device_put(0.0, self.flat_devices[0]) + 0).block_until_ready()
+        me = jax.process_index()
+        local = [d for d in self.flat_devices if d.process_index == me]
+        if local:
+            (jax.device_put(0.0, local[0]) + 0).block_until_ready()
 
     def __repr__(self):
         return f"PhysicalDeviceMesh(shape={self.shape})"

@@ -37,9 +37,10 @@ def initialize(coordinator_address: Optional[str] = None,
     ``JAX_PROCESS_ID``).
     """
     global _initialized
-    if _initialized or jax.process_count() > 1:
-        _initialized = True
+    if _initialized:
         return
+    # NOTE: do not probe jax.process_count() here — it would initialize
+    # the backend, after which jax.distributed.initialize cannot run.
     kwargs = {}
     if coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS"):
         kwargs["coordinator_address"] = (
@@ -97,3 +98,150 @@ def broadcast_from_coordinator(pytree):
     """Make host-0's values visible on every host."""
     from jax.experimental import multihost_utils
     return multihost_utils.broadcast_one_to_all(pytree)
+
+
+########################################
+# cross-process array movement
+########################################
+# The multi-controller analog of the reference's driver-side fetch +
+# NCCL send/recv (ref device_mesh.py:1175 fetch, cross_mesh NCCL groups):
+# jax cannot device_put an existing array onto devices of another process,
+# so cross-mesh transfers that cross a process boundary are host-mediated
+# — every process reconstructs the full value (one psum-style collective
+# over all global devices), then re-places its own shards.  Correct for
+# any sharding pair; the DCN cost is one full-array broadcast, which is
+# acceptable for the validation path (production cross-slice transfers
+# ride the compiled device_put fast path inside one process, or a
+# dedicated interconnect transfer library).
+
+
+def host_gather(arr) -> "np.ndarray":
+    """Full value of a (possibly non-fully-addressable) global jax.Array,
+    materialized identically on every process.
+
+    Multi-process semantics: this is a COLLECTIVE — every process must
+    call it for the same array in the same order (the usual SPMD
+    contract), even processes that could read the value locally.  The
+    decision to take the collective path depends only on process_count,
+    never on per-process addressability, so the collective sequence is
+    identical everywhere.  Each process paints its replica-0 shards onto
+    a zero canvas and one global-device sum reconstructs the full value
+    on all hosts.
+    """
+    import numpy as np
+
+    if jax.process_count() <= 1:
+        return np.asarray(arr)
+
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    shape = arr.shape
+    dtype = np.dtype(arr.dtype)
+    # psum-safe working dtype: widen sub-word types; keep word-size and
+    # wider types exact (an int64/float64 array can only exist with x64
+    # enabled, in which case psum carries it losslessly)
+    if dtype == np.bool_:
+        work = np.dtype(np.int32)
+    elif dtype.itemsize < 4:
+        work = (np.dtype(np.int32) if dtype.kind in "iu"
+                else np.dtype(np.float32))
+    else:
+        work = dtype
+
+    canvas = np.zeros(shape, work)
+    for s in arr.addressable_shards:
+        if s.replica_id == 0:
+            canvas[s.index] = np.asarray(s.data).astype(work)
+
+    devs = jax.devices()
+    gmesh = Mesh(np.array(devs), ("p",))
+    slot_sh = NamedSharding(gmesh, P("p"))
+    # this process's canvas rides in its first local device's slot; its
+    # other local slots carry zeros (make_array skips the cross-process
+    # value-consistency check that device_put(host, ...) enforces)
+    first_local = min(jax.local_devices(), key=lambda d: d.id)
+    zeros = np.zeros((1,) + tuple(shape), work)
+    shards = [
+        jax.device_put(
+            jnp.asarray(canvas[None] if d == first_local else zeros), d)
+        for d in jax.local_devices()
+    ]
+    stacked = jax.make_array_from_single_device_arrays(
+        (len(devs),) + tuple(shape), slot_sh, shards, dtype=work)
+    summed = jax.jit(lambda a: a.sum(0),
+                     out_shardings=NamedSharding(gmesh, P()))(stacked)
+    full = np.asarray(summed.addressable_shards[0].data)
+    if dtype == np.bool_:
+        return full != 0
+    return full.astype(dtype)
+
+
+def is_process_local(arr) -> bool:
+    """True for arrays that are this process's own (uncommitted results
+    of local computation, or explicitly placed on one local device) as
+    opposed to global arrays whose sharding metadata is identical on all
+    processes.  Process-local arrays follow the SPMD host-input contract:
+    every process passes its own identical copy."""
+    from jax.sharding import SingleDeviceSharding
+    committed = getattr(arr, "committed", getattr(arr, "_committed", True))
+    return (not committed) or isinstance(arr.sharding,
+                                         SingleDeviceSharding)
+
+
+def ghost_array(shape, sharding, dtype):
+    """A global array handle with only this process's shards materialized
+    (zero-filled); processes owning no devices of ``sharding`` get a pure
+    metadata handle.  The multi-controller stand-in for 'this value lives
+    on another host'."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    idx_map = sharding.addressable_devices_indices_map(tuple(shape))
+    arrs = []
+    for d, idx in idx_map.items():
+        shard_shape = tuple(
+            len(range(*sl.indices(dim))) for sl, dim in
+            zip(idx, shape)) if idx is not None and len(shape) else ()
+        arrs.append(jax.device_put(jnp.zeros(shard_shape, dtype), d))
+    return jax.make_array_from_single_device_arrays(
+        tuple(shape), sharding, arrs, dtype=np.dtype(dtype))
+
+
+def put_global(value, sharding):
+    """``jax.device_put`` that survives process boundaries.
+
+    Multi-process semantics: COLLECTIVE when ``value`` is a jax.Array
+    whose devices are not confined to a single process identical to the
+    destination's — every process must call it in the same order.  The
+    path choice depends only on global metadata (sharding device sets),
+    never on per-process addressability, so all processes stay aligned:
+
+    - host values: plain device_put (places local shards; identical
+      value on every process by the SPMD input contract);
+    - array whose src+dst devices live on one process: that process
+      device_puts locally, the others build a ghost handle (no
+      collective);
+    - anything else (a transfer that crosses a process boundary):
+      host-mediated — a host_gather collective, then local placement.
+
+    Single-process behavior is exactly ``jax.device_put``.
+    """
+    if jax.process_count() <= 1 or not isinstance(value, jax.Array):
+        return jax.device_put(value, sharding)
+    if is_process_local(value):
+        # each process holds its own (identical, by the SPMD input
+        # contract) copy: treat as a host value — its device metadata
+        # differs per process and must not steer the branch below
+        import numpy as np
+        return jax.device_put(np.asarray(value), sharding)
+    src_procs = {d.process_index for d in value.sharding.device_set}
+    dst_procs = {d.process_index for d in sharding.device_set}
+    me = jax.process_index()
+    if len(src_procs) == 1 and src_procs == dst_procs:
+        owner = next(iter(src_procs))
+        if owner == me:
+            return jax.device_put(value, sharding)
+        return ghost_array(value.shape, sharding, value.dtype)
+    # crosses a process boundary: host-mediated (collective)
+    return jax.device_put(host_gather(value), sharding)

@@ -101,6 +101,151 @@ def spmd_pipeline(stage_fn: Callable,
     return sm(stage_params, microbatches, extra_args)
 
 
+def spmd_pipeline_1f1b(stage_fn: Callable,
+                       last_stage_loss_fn: Callable,
+                       stage_params: Any,
+                       microbatches: jnp.ndarray,
+                       mb_labels: Any,
+                       *,
+                       mesh: Mesh,
+                       pp_axis: str = "pp",
+                       extra_args: Any = None):
+    """Single-program 1F1B pipeline: fwd and bwd interleaved in ONE scan.
+
+    GPipe-via-autodiff (``spmd_pipeline`` + ``jax.grad``) keeps every
+    microbatch's activations alive across the forward scan — O(n_mb)
+    memory.  Here each global tick runs one forward AND one backward unit
+    per rank (classic 1F1B: a microbatch's backward starts as soon as its
+    forward reaches the last stage), so at most ``2S-1`` microbatch
+    activations are in flight — O(S) memory — and only the stage INPUT is
+    stored (the stage body recomputes inside ``jax.vjp`` at its backward
+    tick: per-stage remat).  Activations flow to the next rank and
+    cotangents to the previous rank with ``ppermute`` over ICI each tick;
+    XLA overlaps both with compute.  Semantic target: the multi-mesh
+    runtime's 1F1B order (ref alpa/pipeline_parallel/schedules.py:271);
+    no reference analog exists for the single-program form.
+
+    Schedule (rank r of S, microbatch m of M, tick t of M + 2S - 2):
+      forward  of m at rank r:  t = m + r
+      backward of m at rank r:  t = m + 2(S-1) - r
+    On the last rank both land on the same tick: forward, loss, and the
+    seed cotangent happen together and backward starts immediately.
+
+    Args:
+      stage_fn: ``(params_slice, x, extra) -> y``, same contract as
+        :func:`spmd_pipeline`.
+      last_stage_loss_fn: ``(y, label_slice) -> scalar`` mean-per-
+        microbatch loss applied to the LAST stage's output; its VJP seeds
+        the backward pass on-pipeline.
+      stage_params: pytree, leaves ``[S, ...]``, sharded over ``pp_axis``.
+      microbatches: ``[M, mb, ...]`` stacked first-stage inputs.
+      mb_labels: pytree of ``[M, ...]`` per-microbatch labels.
+
+    Returns:
+      (mean_loss, stage_grads, d_microbatches): loss averaged over
+      microbatches; grads with the same ``[S, ...]`` layout as
+      ``stage_params``; cotangents of ``microbatches`` for chaining into
+      an embedding backward.
+    """
+    S = mesh.shape[pp_axis]
+    M = microbatches.shape[0]
+    T = M + 2 * S - 2
+    n_slots = 2 * S  # > max in-flight (2S-1)
+
+    def pipelined(params, mbs, labels, extra):
+        params = jax.tree_util.tree_map(lambda x: x[0], params)
+        rank = lax.axis_index(pp_axis)
+        is_first = rank == 0
+        is_last = rank == S - 1
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+
+        def tick(carry, t):
+            xbuf, recv_y, recv_dy, wgrad, loss_acc, dx_out = carry
+
+            # ---------------- forward unit ----------------
+            m_f = t - rank
+            do_f = jnp.logical_and(m_f >= 0, m_f < M)
+            m_f_c = jnp.clip(m_f, 0, M - 1)
+            x_in = jnp.where(is_first,
+                             lax.dynamic_index_in_dim(mbs, m_f_c, 0,
+                                                      keepdims=False),
+                             recv_y)
+            y = stage_fn(params, x_in, extra)
+            slot_f = m_f_c % n_slots
+            old = lax.dynamic_index_in_dim(xbuf, slot_f, 0, keepdims=False)
+            xbuf = lax.dynamic_update_index_in_dim(
+                xbuf, jnp.where(do_f, x_in, old), slot_f, 0)
+
+            # ---------------- backward unit ----------------
+            m_b = t - 2 * (S - 1) + rank
+            do_b = jnp.logical_and(m_b >= 0, m_b < M)
+            m_b_c = jnp.clip(m_b, 0, M - 1)
+            x_saved = lax.dynamic_index_in_dim(xbuf, m_b_c % n_slots, 0,
+                                               keepdims=False)
+            lbl = jax.tree_util.tree_map(
+                lambda l: lax.dynamic_index_in_dim(l, m_b_c, 0,
+                                                   keepdims=False), labels)
+            # ONE recomputed-fwd VJP serves both cases via a masked
+            # surrogate: the last rank differentiates loss/M (seeding the
+            # pipeline backward), other ranks differentiate <y, recv_dy>
+            # (i.e. the VJP against the received cotangent).  jnp.where
+            # routes the cotangent, so the unselected branch contributes
+            # zero gradient.
+            def surrogate(p, x):
+                y = stage_fn(p, x, extra)
+                loss = last_stage_loss_fn(y, lbl)
+                pulled = jnp.sum(y.astype(jnp.float32) *
+                                 recv_dy.astype(jnp.float32))
+                return jnp.where(is_last, loss / M, pulled), loss
+
+            (dp, dx), loss_m = jax.grad(
+                surrogate, argnums=(0, 1), has_aux=True)(params, x_saved)
+            wgrad = jax.tree_util.tree_map(
+                lambda w, g: w + jnp.where(do_b, g, jnp.zeros_like(g)),
+                wgrad, dp)
+            loss_acc = loss_acc + jnp.where(
+                jnp.logical_and(is_last, do_b), loss_m / M, 0.0)
+            dx_first = jnp.where(
+                jnp.logical_and(is_first, do_b), dx,
+                jnp.zeros_like(dx))
+            dx_out = lax.dynamic_update_index_in_dim(
+                dx_out,
+                dx_first + lax.dynamic_index_in_dim(dx_out, m_b_c, 0,
+                                                    keepdims=False),
+                m_b_c, 0)
+
+            # ---------------- communicate ----------------
+            nxt_y = lax.ppermute(y, pp_axis, fwd_perm)
+            nxt_dy = lax.ppermute(dx, pp_axis, bwd_perm)
+            return (xbuf, nxt_y, nxt_dy, wgrad, loss_acc, dx_out), None
+
+        mb_shape = microbatches.shape[1:]
+        xbuf0 = jnp.zeros((n_slots,) + mb_shape, microbatches.dtype)
+        recv0 = jnp.zeros(mb_shape, microbatches.dtype)
+        wgrad0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        dx_out0 = jnp.zeros_like(mbs)
+        carry0 = (xbuf0, recv0, recv0, wgrad0, jnp.zeros(()), dx_out0)
+        (xbuf, _, _, wgrad, loss_acc, dx_out), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        # loss lives on the last rank, dx_out on the first: share over pp
+        loss = lax.psum(jnp.where(is_last, loss_acc, 0.0), pp_axis)
+        dx_out = lax.psum(
+            jnp.where(is_first, dx_out, jnp.zeros_like(dx_out)), pp_axis)
+        # re-attach the leading stage dim for the [S, ...] grads layout
+        wgrad = jax.tree_util.tree_map(lambda g: g[None], wgrad)
+        return loss, wgrad, dx_out
+
+    sm = jax.shard_map(pipelined,
+                       mesh=mesh,
+                       in_specs=(P(pp_axis), P(), P(), P()),
+                       out_specs=(P(), P(pp_axis), P()),
+                       axis_names={pp_axis},
+                       check_vma=False)
+    return sm(stage_params, microbatches, mb_labels, extra_args)
+
+
 def pipeline_train_step_builder(embed_fn: Callable,
                                 stage_fn: Callable,
                                 head_loss_fn: Callable,

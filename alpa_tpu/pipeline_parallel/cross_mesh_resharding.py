@@ -137,7 +137,9 @@ class ReshardingTaskSpec:
     # so an executor can route each planned TileSlice to real devices
     src_device_ids: Tuple[int, ...] = ()
     dst_device_ids: Tuple[int, ...] = ()
-    # per destination shard, the FULL tile it must end up holding
+    # per source/destination shard, the FULL tile it holds; src_tiles lets
+    # the executor verify the runtime array's layout matches the plan
+    src_tiles: Tuple[Tile, ...] = ()
     dst_tiles: Tuple[Tile, ...] = ()
 
     def total_tiles(self):
@@ -226,6 +228,7 @@ def plan_resharding(shape: Tuple[int, ...],
                               allgather_rewrite,
                               src_device_ids=tuple(src_vda.device_ids),
                               dst_device_ids=tuple(dst_vda.device_ids),
+                              src_tiles=tuple(src_vda.device_tiles),
                               dst_tiles=tuple(dst_vda.device_tiles))
 
 
@@ -304,16 +307,32 @@ class ReshardingTask:
             # Planned modes drive transfers from the controller and need
             # every source/destination shard addressable; on a multi-host
             # run fall back to the runtime-carried transfer.
-            global _warned_fallback
-            if not _warned_fallback:
-                _warned_fallback = True
-                logger.warning(
-                    "planned resharding execution needs all shards "
-                    "addressable from this process; falling back to "
-                    "device_put (warned once)")
-            self.last_report = ExecutionReport(mode="device_put")
-            return jax.device_put(src_array, self.dst_sharding)
+            return self._fallback(src_array,
+                                  "needs all shards addressable from "
+                                  "this process")
+        if self.spec.src_tiles:
+            # the array's ACTUAL layout must match the plan's source
+            # sharding — the emit-model sharding can diverge from what a
+            # stage executable really produced; slicing with the planned
+            # offsets would then assemble wrong values
+            actual = VirtualDistributedArray.from_sharding(
+                self.spec.shape, src_array.sharding)
+            if (tuple(actual.device_ids) != self.spec.src_device_ids or
+                    tuple(actual.device_tiles) != self.spec.src_tiles):
+                return self._fallback(src_array,
+                                      "source layout diverged from plan")
         return self._run_planned(src_array, broadcast=(mode == "broadcast"))
+
+    def _fallback(self, src_array, why: str):
+        import jax
+        global _warned_fallback
+        if not _warned_fallback:
+            _warned_fallback = True
+            logger.warning(
+                "planned resharding execution %s; falling back to "
+                "device_put (warned once)", why)
+        self.last_report = ExecutionReport(mode="device_put")
+        return jax.device_put(src_array, self.dst_sharding)
 
     # -- planned execution --------------------------------------------
 

@@ -549,6 +549,15 @@ class PipeshardDriverExecutable:
             raise ValueError(
                 "global_config.resharding_execution must be 'device_put' "
                 f"or 'planned', got {exec_mode!r}")
+        multiprocess = jax.process_count() > 1
+        if multiprocess:
+            # cross-process placement/transfers are host-mediated; the
+            # planned executor is a single-controller validation mode
+            from alpa_tpu.distributed import host_gather, put_global
+            _put = put_global
+            exec_mode = "device_put"
+        else:
+            _put = jax.device_put
 
         # place global inputs
         for v, places in self.input_place.items():
@@ -558,18 +567,26 @@ class PipeshardDriverExecutable:
                 if n_mb == 1:
                     mbs = [arg]
                 elif isinstance(arg, jax.Array):
-                    # split on device: avoids a blocking D2H round trip
-                    mbs = jnp.split(arg, n_mb, axis=0)
+                    from alpa_tpu.distributed import is_process_local
+                    if multiprocess and not is_process_local(arg):
+                        # global array: collective gather (path choice uses
+                        # only global metadata, so processes stay aligned)
+                        mbs = np.split(host_gather(arg), n_mb, axis=0)
+                    elif multiprocess:
+                        mbs = np.split(np.asarray(arg), n_mb, axis=0)
+                    else:
+                        # split on device: avoids a blocking D2H round trip
+                        mbs = jnp.split(arg, n_mb, axis=0)
                 else:
                     mbs = np.split(np.asarray(arg), n_mb, axis=0)
                 for mb in range(n_mb):
                     slot = env.setdefault((v, mb), {})
                     for mesh_id, sharding in places:
-                        slot[mesh_id] = jax.device_put(mbs[mb], sharding)
+                        slot[mesh_id] = _put(mbs[mb], sharding)
             else:
                 slot = env.setdefault((v, -1), {})
                 for mesh_id, sharding in places:
-                    slot[mesh_id] = jax.device_put(arg, sharding)
+                    slot[mesh_id] = _put(arg, sharding)
 
         # place consts (cached across calls)
         if self._const_cache is None:
@@ -578,7 +595,7 @@ class PipeshardDriverExecutable:
                 val = self.consts_map[v]
                 slot = {}
                 for mesh_id, sharding in places:
-                    slot[mesh_id] = jax.device_put(val, sharding)
+                    slot[mesh_id] = _put(val, sharding)
                 self._const_cache[v] = slot
         for v, slot in self._const_cache.items():
             env[(v, -1)] = dict(slot)
@@ -620,7 +637,7 @@ class PipeshardDriverExecutable:
                         logger.debug(
                             "emit-model sharding miss: %s arg[%d] %s -> %s",
                             inst.info, i, a.sharding.spec, s.spec)
-                        args[i] = jax.device_put(a, s)
+                        args[i] = _put(a, s)
                 outs = exec_.compiled(*args)
                 for k, o in zip(inst.output_keys, outs):
                     env.setdefault(k, {})[inst.dst_mesh] = o
@@ -646,7 +663,7 @@ class PipeshardDriverExecutable:
                     self._executed_resharding_bytes += rep.cross_mesh_bytes
                     self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
                 else:
-                    env[inst.var_key][inst.dst_mesh] = jax.device_put(
+                    env[inst.var_key][inst.dst_mesh] = _put(
                         val, inst.dst_sharding)
                 if collect:
                     tracer.log("RESHARD", inst.info)

@@ -85,12 +85,20 @@ SWEEPS = {
         ("flash", True, True, 768, 12),
     ],
     # model-size sweep: bigger models amortize overhead -> higher MFU;
-    # batch stays at 8 (the relay wedges above that)
+    # batch stays at 8 (the relay wedges above that).  Result: monotone
+    # rise 60.1 (h1024 l24) -> 70.9 (h1536 l24) -> 75.2 (h2048 l16),
+    # all with remat; the h1024 no-remat variant failed remote compile.
     "size": [
         ("reference", False, False, 1024, 24),
         ("reference", True, False, 1024, 24),
         ("reference", True, False, 1536, 24),
         ("reference", True, True, 2048, 16),
+    ],
+    # second rung: find the peak around GPT-1.3B-class shapes
+    "size2": [
+        ("reference", True, False, 2048, 16),
+        ("reference", True, True, 2048, 24),
+        ("reference", True, True, 2560, 16),
     ],
 }
 

@@ -1,0 +1,111 @@
+"""Worker for the 2-process jax.distributed CPU test.
+
+Each process pins a 4-device virtual CPU backend, joins the coordinator,
+and drives alpa_tpu over the resulting 8-device global mesh — proving
+the single-controller design survives a process boundary (VERDICT r1
+next#6; analog of the reference's Ray-emulated multi-host tests,
+ref tests/pipeline_parallel/ + alpa/device_mesh.py:979).
+
+Run (same on both):  python multiprocess_worker.py <process_id> <nproc> <port>
+Prints ``MP_OK <process_id>`` on success.
+"""
+import os
+import sys
+
+
+def main():
+    process_id = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    import alpa_tpu.distributed as dist
+    dist.initialize(coordinator_address=f"127.0.0.1:{port}",
+                    num_processes=nproc, process_id=process_id)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.devices()
+    assert jax.local_device_count() == 4
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from flax.training import train_state
+
+    import alpa_tpu
+    from alpa_tpu.testing import (MLPModel, assert_allclose,
+                                  create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    alpa_tpu.init(cluster="distributed")
+
+    # ---- ShardParallel across the global 8-device mesh ----
+    rng = jax.random.PRNGKey(0)
+    model = MLPModel(hidden_dim=32, output_dim=32, num_layers=2,
+                     manual_pipeline_layer=False)
+    x = jax.random.normal(rng, (32, 32))
+    y = jax.random.normal(jax.random.PRNGKey(1), (32, 32))
+    params = model.init(rng, x)
+    tx = optax.sgd(0.05)
+    state_p = train_state.TrainState.create(apply_fn=model.apply,
+                                            params=params, tx=tx)
+    state_s = train_state.TrainState.create(apply_fn=model.apply,
+                                            params=params, tx=tx)
+
+    @alpa_tpu.parallelize(method=alpa_tpu.ShardParallel(),
+                          donate_argnums=())
+    def pstep(state, batch):
+        def loss_fn(p):
+            out = state.apply_fn(p, batch["x"])
+            return jnp.mean((out - batch["y"]) ** 2)
+        loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    @jax.jit
+    def sstep(state, batch):
+        def loss_fn(p):
+            out = state.apply_fn(p, batch["x"])
+            return jnp.mean((out - batch["y"]) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    batch = {"x": x, "y": y}
+    for _ in range(3):
+        state_p, loss_p = pstep(state_p, batch)
+        state_s, loss_s = sstep(state_s, batch)
+        assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
+    print(f"shard_parallel ok: loss {float(loss_p):.6f}", flush=True)
+
+    # ---- 2-stage pipeshard step, each stage mesh spanning both hosts ----
+    from alpa_tpu import PipeshardParallel
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        ManualLayerOption)
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+
+    method = PipeshardParallel(num_micro_batches=2,
+                               layer_option=ManualLayerOption(),
+                               stage_option=UniformStageOption(num_stages=2))
+    state_pp, pbatch = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    state_ps, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    ppstep = get_mlp_train_step(method, use_value_and_grad=True)
+    serial = get_mlp_train_step(None)
+    state_pp, loss_pp = ppstep(state_pp, pbatch)
+    state_ps, loss_ps = serial(state_ps, pbatch)
+    # outputs live on their producing stage's mesh — not all addressable
+    # from every process; host_gather reconstructs them everywhere
+    lp = float(dist.host_gather(loss_pp))
+    assert_allclose(float(loss_ps), lp, 2e-3, 2e-3)
+    params_p = jax.tree_util.tree_map(dist.host_gather, state_pp.params)
+    assert_allclose(jax.device_get(state_ps.params), params_p, 2e-3, 2e-3)
+    print(f"pipeshard ok: loss {lp:.6f}", flush=True)
+
+    dist.sync_global_devices("done")
+    print(f"MP_OK {process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,7 +5,9 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from alpa_tpu.parallel.spmd_pipeline import spmd_pipeline, stack_pytrees
+from alpa_tpu.parallel.spmd_pipeline import (spmd_pipeline,
+                                             spmd_pipeline_1f1b,
+                                             stack_pytrees)
 
 
 def _mesh(shape, names):
@@ -71,6 +73,81 @@ class TestSpmdPipeline:
         gs = jax.grad(loss_s)(stacked_host, x)
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gs),
                                    rtol=1e-4, atol=1e-6)
+
+
+class TestSpmdPipeline1F1B:
+    """Single-program 1F1B: loss + grads + input cotangents from one
+    interleaved scan must match serial autodiff (VERDICT r1 next#8)."""
+
+    def _setup(self, S, M, dim=8, mb=2):
+        mesh = _mesh((S,), ("pp",))
+        Ws = [
+            jax.random.normal(jax.random.PRNGKey(i), (dim, dim)) * 0.3
+            for i in range(S)
+        ]
+        stacked_host = jnp.stack(Ws)
+        stacked = jax.device_put(stacked_host,
+                                 NamedSharding(mesh, P("pp")))
+        x = jax.random.normal(jax.random.PRNGKey(9), (M * mb, dim))
+        labels = jax.random.normal(jax.random.PRNGKey(7), (M * mb, dim))
+        return mesh, stacked_host, stacked, x, labels
+
+    @staticmethod
+    def _stage_fn(W, x, _):
+        return jnp.tanh(x @ W)
+
+    @staticmethod
+    def _loss_fn(y, lbl):
+        return jnp.mean((y - lbl) ** 2)
+
+    @pytest.mark.parametrize("S,M", [(4, 4), (4, 8), (8, 8)])
+    def test_matches_serial(self, S, M):
+        mesh, stacked_host, stacked, x, labels = self._setup(S, M)
+        mb = x.shape[0] // M
+
+        def run(stacked, x, labels):
+            mbs = x.reshape(M, mb, -1)
+            lbls = labels.reshape(M, mb, -1)
+            return spmd_pipeline_1f1b(self._stage_fn, self._loss_fn,
+                                      stacked, mbs, lbls, mesh=mesh)
+
+        with jax.set_mesh(mesh):
+            loss, wgrad, dx = jax.jit(run)(stacked, x, labels)
+
+        def loss_s(stacked, x):
+            h = x
+            for s in range(S):
+                h = jnp.tanh(h @ stacked[s])
+            # mean over microbatches of per-microbatch means == global
+            # mean when microbatches are equal sized
+            return jnp.mean((h - labels) ** 2)
+
+        ls = loss_s(stacked_host, x)
+        gs, dxs = jax.grad(loss_s, argnums=(0, 1))(stacked_host, x)
+        np.testing.assert_allclose(float(loss), float(ls), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(wgrad), np.asarray(gs),
+                                   rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(dx).reshape(x.shape), np.asarray(dxs),
+            rtol=1e-4, atol=1e-6)
+
+    def test_collectives_present(self):
+        """Both directions of the pipeline ride ppermute (fwd
+        activations + bwd cotangents), not all-gathers."""
+        S, M = 4, 4
+        mesh, _, stacked, x, labels = self._setup(S, M)
+        mb = x.shape[0] // M
+
+        def run(stacked, x, labels):
+            mbs = x.reshape(M, mb, -1)
+            lbls = labels.reshape(M, mb, -1)
+            return spmd_pipeline_1f1b(self._stage_fn, self._loss_fn,
+                                      stacked, mbs, lbls, mesh=mesh)
+
+        with jax.set_mesh(mesh):
+            hlo = (jax.jit(run).lower(stacked, x, labels).compile()
+                   .as_text())
+        assert "collective-permute" in hlo
 
 
 class TestGraftEntry:

@@ -1,0 +1,60 @@
+"""Multi-process runtime proof (VERDICT r1 next#6).
+
+Spawns two real OS processes joined via ``jax.distributed`` (4 virtual
+CPU devices each -> 8 global) and drives both ShardParallel and a
+2-stage pipeshard train step whose stage meshes live on DIFFERENT
+processes, with a serial-equivalence oracle inside each worker.  Analog
+of the reference's Ray-emulated multi-host tests
+(ref tests/pipeline_parallel/, alpa/device_mesh.py:979-1147).
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+WORKER = os.path.join(REPO_ROOT, "scripts", "multiprocess_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_runtime():
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "",
+        "PYTHONPATH": REPO_ROOT,
+    })
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True) for i in range(2)
+    ]
+    outs = []
+    for i, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {i} timed out")
+        outs.append((p.returncode, out, err))
+    for i, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (f"worker {i} rc={rc}\n--- stdout:\n{out[-2000:]}"
+                         f"\n--- stderr:\n{err[-3000:]}")
+        assert f"MP_OK {i}" in out, out[-2000:]
+        assert "shard_parallel ok" in out
+        assert "pipeshard ok" in out
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
