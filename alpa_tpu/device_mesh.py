@@ -102,7 +102,8 @@ class LogicalDeviceMesh:
             ratio = self.mesh_beta[mesh_dim] / min(self.mesh_beta)
             return ab[0], ab[1] * ratio, 0.0
         ties = {"all_gather": 0.1, "all_reduce": 0.01,
-                "reduce_scatter": 0.001, "all_to_all": 0.001}
+                "reduce_scatter": 0.001, "all_to_all": 0.001,
+                "ppermute": 0.0005}
         return (self.mesh_alpha[mesh_dim], self.mesh_beta[mesh_dim],
                 ties[kind])
 
@@ -145,6 +146,16 @@ class LogicalDeviceMesh:
             return 0.0
         a, b, tie = self._ab("all_to_all", mesh_dim)
         return a + b * (n - 1) / (n * n) * num_bytes + tie
+
+    def ppermute_cost(self, num_bytes: float, mesh_dim: int) -> float:
+        """Neighbor exchange (halo) along one axis: one hop, no ring
+        factor.  Used by the conv planner's spatial (halo-exchange)
+        strategies."""
+        n = self.shape[mesh_dim]
+        if n == 1:
+            return 0.0
+        a, b, tie = self._ab("ppermute", mesh_dim)
+        return a + b * num_bytes + tie
 
     def resharding_cost_mixed(self, num_bytes: float) -> float:
         """Cost of an unmodeled layout change (conservative: allgather all)."""

@@ -31,10 +31,9 @@ class FollowParallel(ParallelMethod):
         from alpa_tpu.pipeline_parallel.pipeshard_executable import (
             PipeshardDriverExecutable)
         if isinstance(src_exec, PipeshardDriverExecutable):
-            raise NotImplementedError(
-                "FollowParallel after a pipeshard executable is not wired "
-                "yet; follow a ShardParallel executable or use "
-                "PipeshardParallel with stage_input_shardings.")
+            return self._compile_following_pipeshard(
+                src_exec, fun, in_avals, in_tree, in_paths,
+                donated_invars, batch_invars)
 
         # Match our inputs to the source executable's inputs by
         # (shape, dtype): shared leaves (params/state) reuse the source
@@ -60,3 +59,73 @@ class FollowParallel(ParallelMethod):
             ],
             out_shardings=list(compiled.output_shardings),
             in_tree=in_tree, out_tree=None)
+
+    def _compile_following_pipeshard(self, src_exec, fun, in_avals,
+                                     in_tree, in_paths, donated_invars,
+                                     batch_invars):
+        """Follow a pipeshard train step (ref follow_parallel.py:25).
+
+        The eval function is compiled as a pipeshard executable with the
+        SOURCE method's options (same layer/stage slicing, same
+        auto-sharding options, same deterministic compile seed), so the
+        shared inputs — the train state resident across the stage meshes
+        — land on identical (mesh, sharding) placements and flow into
+        eval without any cross-mesh movement.  ``follow_report`` on the
+        returned executable records per-placement agreement so tests can
+        assert the follow actually held.
+        """
+        import numpy as np
+
+        from alpa_tpu.parallel_method import PipeshardParallel
+
+        src_method = getattr(self.src_func, "method", None)
+        assert isinstance(src_method, PipeshardParallel), (
+            "source executable is pipeshard but its function does not "
+            "carry a PipeshardParallel method")
+        method = PipeshardParallel(
+            devices=src_method.devices,
+            num_micro_batches=(self.num_micro_batches or 1),
+            default_auto_sharding_option=src_method.as_option,
+            pipeline_schedule=src_method.pipeline_schedule,
+            layer_option=src_method.layer_option,
+            stage_option=src_method.stage_option)
+        exec2 = method.compile_executable(fun, in_avals, in_tree,
+                                          in_paths, donated_invars,
+                                          batch_invars)
+
+        # report how many shared inputs follow the source placement:
+        # match invars by (shape, dtype) and compare (mesh, spec) sets
+        def placement_pool(ex):
+            # batch inputs are fresh host values every call — only the
+            # resident state (non-batch) must follow the source placement
+            batch_vars = {
+                v for v, is_b in zip(ex.global_invars, ex.batch_invars)
+                if is_b
+            }
+            pool = {}
+            for v, places in ex.input_place.items():
+                if v in batch_vars:
+                    continue
+                key = (tuple(v.aval.shape), np.dtype(v.aval.dtype))
+                pool.setdefault(key, []).append(
+                    tuple(sorted((m, str(getattr(s, "spec", s)))
+                                 for m, s in places)))
+            return pool
+
+        src_pool = placement_pool(src_exec)
+        followed = mismatched = 0
+        for key, placements in placement_pool(exec2).items():
+            cands = list(src_pool.get(key, []))
+            for p in placements:
+                if p in cands:
+                    cands.remove(p)   # multiset match: consume candidates
+                    followed += 1
+                else:
+                    mismatched += 1
+        exec2.follow_report = {"followed": followed,
+                               "mismatched": mismatched}
+        if mismatched:
+            logger.info("FollowParallel(pipeshard): %d/%d shared inputs "
+                        "diverged from the source placement", mismatched,
+                        followed + mismatched)
+        return exec2

@@ -81,16 +81,24 @@ def reference_attention(q, k, v, *, causal: bool, offset=0):
     """Plain einsum attention; XLA fuses this well on TPU for short seqs.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D).  fp32 softmax accumulation.
+    ``offset`` shifts query positions for decode-with-cache; a scalar
+    applies to every row, a (B,) vector gives per-row offsets (mixed
+    prompt lengths in one continuously-batched decode).
     """
     dim = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / np.sqrt(dim)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
-        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + offset
+        offset = jnp.asarray(offset, jnp.int32)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        mask = q_pos >= k_pos
-        scores = jnp.where(mask[None, None], scores, jnp.float32(-1e9))
+        if offset.ndim == 0:
+            mask = (q_pos + offset >= k_pos)[None, None]     # (1,1,Sq,Sk)
+        else:
+            mask = (q_pos[None] + offset[:, None, None]
+                    >= k_pos[None])[:, None]                 # (B,1,Sq,Sk)
+        scores = jnp.where(mask, scores, jnp.float32(-1e9))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -127,17 +135,29 @@ class SelfAttention(nn.Module):
         new_cache = None
         if kv_cache is not None:
             k_cache, v_cache, index = kv_cache
-            # write current k/v at position index (decode: s==1)
-            k_full = jax.lax.dynamic_update_slice_in_dim(k_cache, k.astype(
-                k_cache.dtype), index, axis=1)
-            v_full = jax.lax.dynamic_update_slice_in_dim(v_cache, v.astype(
-                v_cache.dtype), index, axis=1)
-            mask_len = index + s
+            index = jnp.asarray(index, jnp.int32)
+            if index.ndim == 0:
+                # uniform write position (classic single-index cache)
+                k_full = jax.lax.dynamic_update_slice_in_dim(
+                    k_cache, k.astype(k_cache.dtype), index, axis=1)
+                v_full = jax.lax.dynamic_update_slice_in_dim(
+                    v_cache, v.astype(v_cache.dtype), index, axis=1)
+                keep_len = index + s                       # scalar
+            else:
+                # per-row write positions: mixed prompt lengths share one
+                # continuously-batched decode (ref wrapper_1d intent)
+                rows = jnp.arange(b)[:, None]
+                cols = index[:, None] + jnp.arange(s)[None, :]
+                k_full = k_cache.at[rows, cols].set(k.astype(k_cache.dtype))
+                v_full = v_cache.at[rows, cols].set(v.astype(v_cache.dtype))
+                keep_len = (index + s)[:, None]            # (B, 1)
             pos = jax.lax.broadcasted_iota(jnp.int32, (k_full.shape[1],), 0)
-            keep = pos < mask_len
-            k_use = jnp.where(keep[None, :, None, None], k_full,
+            keep = pos < keep_len                  # (L,) or (B, L)
+            if keep.ndim == 1:
+                keep = keep[None]
+            k_use = jnp.where(keep[:, :, None, None], k_full,
                               jnp.zeros_like(k_full))
-            v_use = jnp.where(keep[None, :, None, None], v_full,
+            v_use = jnp.where(keep[:, :, None, None], v_full,
                               jnp.zeros_like(v_full))
             # scores to future positions masked by causal offset
             attn = reference_attention(q, k_use, v_use, causal=True,

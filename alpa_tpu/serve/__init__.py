@@ -6,4 +6,6 @@ autoregressive generation engine with resident KV caches compiled per
 (batch, length-bucket).
 """
 from alpa_tpu.serve.generation import GenerationConfig, Generator, get_model
-from alpa_tpu.serve.controller import Controller, run_controller
+from alpa_tpu.serve.controller import (Controller, RequestBatcher,
+                                       run_controller)
+from alpa_tpu.serve.engine import ContinuousBatchingEngine

@@ -3,9 +3,10 @@
 Analog of ref ``alpa/serve/controller.py:96`` (Controller Ray actor with
 uvicorn/starlette ingress + model registry + replica dispatch) — rebuilt on
 the standard library: a ``ThreadingHTTPServer`` front end, a registry of
-named models, round-robin replica dispatch, and per-model locks (device
-execution is serialized per replica; concurrent requests to different
-models overlap through jax's async dispatch).
+named models, round-robin replica dispatch, and a per-replica
+``RequestBatcher`` that coalesces concurrent requests into one
+mixed-length batched generate call (device execution is serialized per
+replica by the batcher's single worker thread).
 
 Endpoints:
   GET  /models                          -> registered model names
@@ -14,6 +15,7 @@ Endpoints:
         => {"output_ids": [[...]]}
   GET  /health                          -> liveness
 """
+import dataclasses
 import json
 import logging
 import threading
@@ -27,11 +29,99 @@ from alpa_tpu.serve.generation import GenerationConfig, Generator
 logger = logging.getLogger(__name__)
 
 
+class RequestBatcher:
+    """Groups concurrent completion requests into ONE mixed-length
+    batched ``Generator.generate`` call (iteration-level batching; the
+    analog of ref ``wrapper_1d.py``'s 1-D batching).  Requests arriving
+    while the device is busy queue up and ride the next batch instead of
+    serializing one generate per request.  Only requests with identical
+    sampling settings share a batch; ``max_new_tokens`` may differ (the
+    batch runs to the max, each request is truncated to its own)."""
+
+    def __init__(self, generator: Generator, max_batch: int = 8,
+                 max_wait_ms: float = 2.0):
+        self.generator = generator
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        self._queue: List[dict] = []
+        self._cv = threading.Condition()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        self.batches_run = 0          # introspection for tests
+
+    def submit(self, prompts: List[np.ndarray],
+               cfg: GenerationConfig) -> List[np.ndarray]:
+        item = {"prompts": prompts, "cfg": cfg,
+                "done": threading.Event(), "result": None, "error": None}
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+        item["done"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        return item["result"]
+
+    @staticmethod
+    def _group_key(cfg: GenerationConfig):
+        return (cfg.do_sample, cfg.temperature, cfg.top_k,
+                cfg.eos_token_id)
+
+    def _run(self):
+        import time
+        while True:
+            with self._cv:
+                while not self._queue:
+                    self._cv.wait()
+                # small window lets concurrent arrivals coalesce
+                deadline = time.monotonic() + self.max_wait_s
+            while time.monotonic() < deadline:
+                time.sleep(self.max_wait_s / 4)
+            with self._cv:
+                if not self._queue:
+                    continue
+                key = self._group_key(self._queue[0]["cfg"])
+                batch, rest, n = [], [], 0
+                for item in self._queue:
+                    fits = n + len(item["prompts"]) <= self.max_batch
+                    # an oversized request runs alone rather than
+                    # starving (its batch is just bigger)
+                    if (self._group_key(item["cfg"]) == key and
+                            (fits or not batch)):
+                        batch.append(item)
+                        n += len(item["prompts"])
+                    else:
+                        rest.append(item)
+                self._queue = rest
+            try:
+                prompts = [p for it in batch for p in it["prompts"]]
+                run_cfg = dataclasses.replace(
+                    batch[0]["cfg"],
+                    max_new_tokens=max(it["cfg"].max_new_tokens
+                                       for it in batch))
+                outs = self.generator.generate(prompts, run_cfg)
+                self.batches_run += 1
+                i = 0
+                for it in batch:
+                    k = len(it["prompts"])
+                    rows = []
+                    for j, p in enumerate(it["prompts"]):
+                        row = outs[i + j]
+                        limit = len(p) + it["cfg"].max_new_tokens
+                        rows.append(row[:limit])
+                    it["result"] = rows
+                    it["done"].set()
+                    i += k
+            except Exception as e:  # pylint: disable=broad-except
+                for it in batch:
+                    it["error"] = e
+                    it["done"].set()
+
+
 class _Replica:
 
     def __init__(self, generator: Generator):
         self.generator = generator
-        self.lock = threading.Lock()
+        self.batcher = RequestBatcher(generator)
 
 
 class Controller:
@@ -74,9 +164,8 @@ class Controller:
             do_sample=bool(request.get("do_sample", False)),
             eos_token_id=request.get("eos_token_id"))
         replica = self._pick_replica(name)
-        with replica.lock:
-            out = replica.generator.generate(prompt_ids, cfg)
-        return {"output_ids": out.tolist()}
+        outs = replica.batcher.submit(list(prompt_ids), cfg)
+        return {"output_ids": [o.tolist() for o in outs]}
 
 
 class _Handler(BaseHTTPRequestHandler):

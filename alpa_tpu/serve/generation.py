@@ -45,25 +45,56 @@ def _sample_logits(logits, rng, cfg: GenerationConfig):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def default_prompt_buckets(seq_len: int) -> List[int]:
+    """Power-of-two prompt-length buckets up to seq_len."""
+    buckets, b = [], 32
+    while b < seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(seq_len)
+    return buckets
+
+
 class Generator:
-    """Compiled prefill + decode loop over a GPT-family model."""
+    """Compiled prefill + decode loop over a GPT-family model.
+
+    Shape bucketing (ref wrapper_1d.py intent): prompts are right-padded
+    to a fixed bucket ladder, so serving traffic with arbitrary prompt
+    lengths compiles exactly one prefill per (batch, bucket) pair and one
+    decode per batch — not one pair per request shape.  Right padding is
+    safe because the causal mask bounds attention to positions < the
+    per-row write index, and each decode step overwrites the padded
+    garbage at its position before that position ever becomes attendable.
+    Mixed prompt lengths share one batch via per-row KV-cache indices.
+    ``prefill_traces`` / ``decode_traces`` count actual retraces so tests
+    can hold the bucketing to its promise.
+    """
 
     def __init__(self, model: GPTModel, params, config: GPTConfig,
-                 batch_size: int = 1):
+                 batch_size: int = 1,
+                 prompt_buckets: Optional[Sequence[int]] = None):
         self.model = model
         self.params = params
         self.config = config
         self.batch_size = batch_size
+        self.prompt_buckets = sorted(prompt_buckets or
+                                     default_prompt_buckets(config.seq_len))
+        self.prefill_traces = 0
+        self.decode_traces = 0
 
-        def prefill(params, input_ids, caches):
+        def prefill(params, input_ids, caches, lengths):
+            self.prefill_traces += 1
             b, s = input_ids.shape
             pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
             logits, caches = model.apply(params, input_ids, pos, caches)
-            return logits[:, -1, :], caches
+            last = logits[jnp.arange(b), lengths - 1]
+            # per-row cache indices: each row continues at its own length
+            caches = [(kc, vc, lengths) for (kc, vc, _i) in caches]
+            return last, caches
 
         def decode(params, token, index, caches):
-            b = token.shape[0]
-            pos = jnp.full((b, 1), index, jnp.int32)
+            self.decode_traces += 1
+            pos = index[:, None]
             logits, caches = model.apply(params, token, pos, caches)
             return logits[:, 0, :], caches
 
@@ -75,37 +106,80 @@ class Generator:
                 lambda x: jnp.take(x, idx, axis=0)
                 if hasattr(x, "ndim") and x.ndim > 0 else x, caches))
 
+    def _bucket_len(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"prompt length {n} exceeds the largest bucket "
+                         f"{self.prompt_buckets[-1]}")
+
     def generate(self,
-                 input_ids: np.ndarray,
+                 input_ids,
                  generation_config: Optional[GenerationConfig] = None,
-                 rng: Optional[jax.Array] = None) -> np.ndarray:
-        """input_ids: (B, S_prompt) -> (B, S_prompt + max_new_tokens)."""
+                 rng: Optional[jax.Array] = None) -> List[np.ndarray]:
+        """Generate for a batch of (possibly mixed-length) prompts.
+
+        ``input_ids``: (B, S) array, or a list of 1-D prompts of varying
+        lengths.  Uniform-length batches return a (B, S + T) array with
+        finished rows eos-padded; mixed-length batches return a list of B
+        1-D arrays (prompt + generation, truncated at eos).
+        """
         cfg = generation_config or GenerationConfig()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        input_ids = jnp.asarray(input_ids, jnp.int32)
-        b, s = input_ids.shape
-        assert s + cfg.max_new_tokens <= self.config.seq_len, (
-            f"prompt {s} + max_new_tokens {cfg.max_new_tokens} exceeds "
-            f"seq_len {self.config.seq_len}")
+        if isinstance(input_ids, (list, tuple)):
+            prompts = [np.asarray(p, np.int32).reshape(-1)
+                       for p in input_ids]
+        else:
+            arr = np.asarray(input_ids, np.int32)
+            if arr.ndim == 1:
+                arr = arr[None]
+            prompts = list(arr)
+        b = len(prompts)
+        lengths = np.array([len(p) for p in prompts], np.int32)
+        s_max = int(lengths.max())
+        assert s_max + cfg.max_new_tokens <= self.config.seq_len, (
+            f"prompt {s_max} + max_new_tokens {cfg.max_new_tokens} "
+            f"exceeds seq_len {self.config.seq_len}")
+        bucket = self._bucket_len(s_max)
+        ids = np.zeros((b, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
 
         caches = init_kv_caches(self.config, b)
-        logits, caches = self._prefill(self.params, input_ids, caches)
-        tokens = [input_ids]
+        lengths_j = jnp.asarray(lengths)
+        logits, caches = self._prefill(self.params, jnp.asarray(ids),
+                                       caches, lengths_j)
+        generated = []
         finished = jnp.zeros((b,), bool)
-        index = s
-        for i in range(cfg.max_new_tokens):
+        index = lengths_j
+        for _ in range(cfg.max_new_tokens):
             rng, sub = jax.random.split(rng)
             nxt = _sample_logits(logits, sub, cfg).astype(jnp.int32)
             if cfg.eos_token_id is not None:
                 nxt = jnp.where(finished, cfg.eos_token_id, nxt)
                 finished = finished | (nxt == cfg.eos_token_id)
-            tokens.append(nxt[:, None])
+            generated.append(nxt)
             logits, caches = self._decode(self.params, nxt[:, None], index,
                                           caches)
-            index += 1
+            index = index + 1
             if cfg.eos_token_id is not None and bool(finished.all()):
                 break
-        return np.asarray(jnp.concatenate(tokens, axis=1))
+        gen = np.stack([np.asarray(g) for g in generated], axis=1) \
+            if generated else np.zeros((b, 0), np.int32)
+        if len(set(lengths.tolist())) == 1:
+            # uniform prompts: 2-D (B, S + T) result, finished rows padded
+            # with eos (classic HF-style batch output)
+            return np.concatenate([np.stack(prompts), gen], axis=1)
+        # mixed lengths: one 1-D row per prompt, truncated at its eos
+        outs = []
+        for i, p in enumerate(prompts):
+            row = gen[i]
+            if cfg.eos_token_id is not None:
+                hits = np.nonzero(row == cfg.eos_token_id)[0]
+                if hits.size:
+                    row = row[:hits[0] + 1]
+            outs.append(np.concatenate([p, row]))
+        return outs
 
 
     def generate_beam(self,
@@ -131,7 +205,8 @@ class Generator:
         # Prefill ONCE (B=1), then broadcast logits + caches across the
         # beam axis — K-times cheaper than prefilling identical copies.
         caches1 = init_kv_caches(self.config, 1)
-        logits1, caches1 = self._prefill(self.params, input_ids, caches1)
+        logits1, caches1 = self._prefill(self.params, input_ids, caches1,
+                                         jnp.full((1,), s, jnp.int32))
         beams = jnp.repeat(input_ids, num_beams, axis=0)     # (K, S)
         logits = jnp.repeat(logits1, num_beams, axis=0)
         caches = jax.tree_util.tree_map(
@@ -169,8 +244,9 @@ class Generator:
             if last_step:
                 break
             caches = self._reorder(caches, beam_idx)
-            logits, caches = self._decode(self.params, tok_idx[:, None],
-                                          index, caches)
+            logits, caches = self._decode(
+                self.params, tok_idx[:, None],
+                jnp.full((num_beams,), index, jnp.int32), caches)
             index += 1
         # best beam by length-normalized score (per-beam generated length)
         norm = scores / (jnp.maximum(gen_len, 1.0)**length_penalty)

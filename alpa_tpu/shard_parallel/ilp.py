@@ -73,14 +73,27 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
     n_vars = off
 
     c = np.zeros(n_vars)
+    biased = []
     for n, o in zip(graph.nodes, node_off):
         for s, st in enumerate(n.strategies):
             c[o + s] = st.comm_cost
+            if getattr(st, "tie_bias", 0.0) > 0:
+                biased.append(o + s)
     for e, o in zip(graph.edges, edge_off):
         c[o:o + e.cost.size] = e.cost.reshape(-1)
     # Normalize for solver conditioning.
     scale = max(1.0, np.abs(c).max() / 1e4)
     c = c / scale
+    # tie_bias steers genuinely-tied choices (e.g. conv batch vs
+    # out-channel sharding) without entering comm accounting.  Applied
+    # AFTER normalization and sized from the smallest real (normalized)
+    # cost so the summed bias can never flip a real cost difference,
+    # while each individual bias stays well above solver tolerance.
+    if biased:
+        pos = c[c > 1e-12]
+        eps = ((pos.min() if pos.size else 1.0) * 1e-3 /
+               max(1, len(biased)))
+        c[np.asarray(biased)] += eps
 
     has_mem = bool(memory_budget)
     n_cons = len(graph.nodes) + sum(
@@ -129,7 +142,9 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
                constraints=cons,
                integrality=integrality,
                bounds=bounds,
-               options={"time_limit": time_limit, "presolve": True})
+               options={"time_limit": time_limit, "presolve": True,
+                        # tight gap so tie_bias-scale terms are honored
+                        "mip_rel_gap": 1e-9})
     # status 0 = optimal; status 1 = time/iteration limit hit, but scipy
     # still returns the best incumbent in res.x — use it rather than
     # falling back to greedy.
@@ -179,7 +194,7 @@ def _solve_greedy(graph: StrategyGraph, sizes: List[int],
 
     def marginal(i, s):
         st = nodes[i].strategies[s]
-        cost = st.comm_cost
+        cost = st.comm_cost + getattr(st, "tie_bias", 0.0)
         for e in in_edges.get(i, ()):
             if decided[e.src]:
                 cost += e.cost[choice[e.src], s]

@@ -107,6 +107,14 @@ class Strategy:
     # resident bytes per device under this strategy (invar nodes: the
     # sharded parameter bytes; used by the ILP memory constraint)
     mem_bytes: float = 0.0
+    # collective realizing comm_cost in the compiled HLO: "all_reduce"
+    # (contracted-dim sharding) or "ppermute" (spatial halo exchange) —
+    # structural tests match the planned kind against the HLO op counts
+    comm_kind: str = "all_reduce"
+    # tiny objective nudge for breaking genuine cost ties (e.g. prefer
+    # batch over out-channel conv sharding, the reference's data-parallel
+    # bias); excluded from comm accounting and solution_cost
+    tie_bias: float = 0.0
 
 
 @dataclasses.dataclass
@@ -375,9 +383,11 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
 
       'b': shard the batch dim (lhs batch <-> out batch),
       'o': shard output channels (rhs O <-> out feature),
-      'i': shard input channels (lhs C + rhs I contracted -> all-reduce).
-
-    Spatial sharding (halo exchange) is not enumerated.
+      'i': shard input channels (lhs C + rhs I contracted -> all-reduce),
+      'g': shard channel groups (grouped/depthwise convs: lhs C, rhs O
+           and out F all sharded along the group axis, no collective),
+      's': shard the first spatial dim (GSPMD inserts the halo exchange;
+           costed as one neighbor ppermute of the halo ring).
     """
     mesh_shape = logical_mesh.shape
     dn = eqn.params["dimension_numbers"]
@@ -386,9 +396,13 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
     lhs_av, rhs_av = eqn.invars[0].aval, eqn.invars[1].aval
     out_av = eqn.outvars[0].aval
     feature_group_count = eqn.params.get("feature_group_count", 1)
+    batch_group_count = eqn.params.get("batch_group_count", 1)
     lhs_b, lhs_c = lhs_spec[0], lhs_spec[1]
     rhs_o, rhs_i = rhs_spec[0], rhs_spec[1]
     out_b, out_f = out_spec_dims[0], out_spec_dims[1]
+    # first spatial dim triple + its kernel extent (for the halo size)
+    lhs_s0, rhs_s0, out_s0 = lhs_spec[2], rhs_spec[2], out_spec_dims[2]
+    kernel0 = int(rhs_av.shape[rhs_s0])
 
     nontrivial = [a for a, s in enumerate(mesh_shape) if s > 1]
     if not nontrivial:
@@ -396,10 +410,13 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
                          (replicated_spec(len(lhs_av.shape)),
                           replicated_spec(len(rhs_av.shape))))]
 
-    roles = ["b", "o"]
-    # contracting input channels is only valid without feature groups
+    roles = ["b", "o", "s"]
+    # contracting input channels is only valid without feature groups;
+    # with groups, the group dim itself is shardable instead
     if feature_group_count == 1:
         roles.append("i")
+    else:
+        roles.append("g")
 
     # Like the dot handler: every non-trivial axis must take a role —
     # the strategy space has no fully-replicated entry (with no compute
@@ -408,15 +425,22 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
     seen = set()
     for assignment in itertools.product(roles, repeat=len(nontrivial)):
         lhs_map, rhs_map, out_map = {}, {}, {}
-        ar_axes = []
+        ar_axes, halo_axes = [], []
         for axis, role in zip(nontrivial, assignment):
             if role == "b":
                 if lhs_b in lhs_map:
+                    break
+                # batch groups must stay intact on each shard
+                if (batch_group_count > 1 and
+                        batch_group_count % mesh_shape[axis] != 0):
                     break
                 lhs_map[lhs_b] = axis
                 out_map[out_b] = axis
             elif role == "o":
                 if rhs_o in rhs_map:
+                    break
+                if (feature_group_count > 1 and
+                        feature_group_count % mesh_shape[axis] != 0):
                     break
                 rhs_map[rhs_o] = axis
                 out_map[out_f] = axis
@@ -426,6 +450,22 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
                 lhs_map[lhs_c] = axis
                 rhs_map[rhs_i] = axis
                 ar_axes.append(axis)
+            elif role == "g":
+                # grouped conv: whole groups split across the axis; lhs
+                # channels, rhs out-channels and out features shard
+                # together, no collective needed
+                if (lhs_c in lhs_map or rhs_o in rhs_map or
+                        feature_group_count % mesh_shape[axis] != 0):
+                    break
+                lhs_map[lhs_c] = axis
+                rhs_map[rhs_o] = axis
+                out_map[out_f] = axis
+            else:  # 's': spatial sharding, halo exchange
+                if lhs_s0 in lhs_map:
+                    break
+                lhs_map[lhs_s0] = axis
+                out_map[out_s0] = axis
+                halo_axes.append(axis)
         else:
             lhs_s = make_spec(len(lhs_av.shape), lhs_map)
             rhs_s = make_spec(len(rhs_av.shape), rhs_map)
@@ -443,9 +483,22 @@ def enumerate_conv_strategies(eqn, logical_mesh) -> List[Strategy]:
                          num_shards(out_s, mesh_shape))
             cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
                        for a in ar_axes)
+            # halo ring: (kernel-1) rows of the per-shard input
+            # cross-section move to each neighbor (GSPMD's exchange)
+            for a in halo_axes:
+                shard_elems = (float(np.prod(lhs_av.shape)) /
+                               num_shards(lhs_s, mesh_shape))
+                spatial_len = max(int(lhs_av.shape[lhs_s0]) //
+                                  mesh_shape[a], 1)
+                halo_bytes = (shard_elems / spatial_len *
+                              max(kernel0 - 1, 0) * lhs_av.dtype.itemsize)
+                cost += logical_mesh.ppermute_cost(halo_bytes, a)
             strategies.append(
                 Strategy("conv" + str(assignment), out_s, cost,
-                         (lhs_s, rhs_s)))
+                         (lhs_s, rhs_s),
+                         comm_kind=("ppermute" if halo_axes and
+                                    not ar_axes else "all_reduce"),
+                         tie_bias=0.0 if "b" in assignment else 1e-6))
     if not strategies:
         strategies.append(
             Strategy("R", replicated_spec(len(out_av.shape)), 0.0,
@@ -650,7 +703,18 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
         from alpa_tpu.shard_parallel.sharding_spec import sharded_bytes
         strategies = [
             Strategy(str(s), s, 0.0,
-                     mem_bytes=sharded_bytes(aval, s, mesh_shape))
+                     mem_bytes=sharded_bytes(aval, s, mesh_shape),
+                     # Reference-aligned tie preferences, epsilon-sized so
+                     # any real cost difference still dominates: batch
+                     # invars prefer a sharded leading (batch) dim; other
+                     # invars (params) prefer replication (the reference's
+                     # allow_replicated_parameters default).  Together the
+                     # ties resolve toward data parallelism.
+                     tie_bias=(1e-6 if (
+                         (i in batch_set and len(aval.shape) and
+                          (not s or not s[0])) or
+                         (i not in batch_set and
+                          any(bool(d) for d in s))) else 0.0))
             for s in specs
         ]
         n = new_node("invar", aval, strategies, f"invar{i}", invar_idx=i)
