@@ -22,7 +22,8 @@ from alpa_tpu.parallel_method import (DataParallel, LocalPipelineParallel,
                                       ShardParallel, Zero2Parallel,
                                       Zero3Parallel, get_3d_parallel_method)
 from alpa_tpu.create_state_parallel import CreateStateParallel
-from alpa_tpu.data_loader import DataLoader, MeshDriverDataLoader
+from alpa_tpu.data_loader import (DataLoader, DistributedDataLoader,
+                                  MeshDriverDataLoader)
 from alpa_tpu.follow_parallel import FollowParallel
 from alpa_tpu.parallel_plan import (ParallelPlan, executable_to_plan,
                                     plan_to_method)

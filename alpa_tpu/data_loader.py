@@ -21,6 +21,32 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def _prefetch_iter(producer: Callable[[], Iterator], prefetch_size: int):
+    """Drain ``producer()`` through a bounded queue on a daemon thread.
+    Worker exceptions are re-raised in the consumer — a failing loader
+    must not look like a (short) completed epoch."""
+    q: "queue.Queue" = queue.Queue(maxsize=prefetch_size)
+    stop = object()
+
+    def worker():
+        try:
+            for item in producer():
+                q.put((None, item))
+        except BaseException as e:  # pylint: disable=broad-except
+            q.put((e, None))
+        q.put((None, stop))
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        err, item = q.get()
+        if err is not None:
+            raise err
+        if item is stop:
+            break
+        yield item
+
+
 class DataLoader:
     """Wrap a host-side iterator; device_put each batch with a sharding,
     prefetching ``prefetch_size`` batches ahead (ref DataLoader:15)."""
@@ -34,27 +60,15 @@ class DataLoader:
         self.prefetch_size = prefetch_size
 
     def __iter__(self):
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_size)
-        stop = object()
 
-        def worker():
-            try:
-                for batch in self.input_iter_func():
-                    placed = jax.tree_util.tree_map(
-                        lambda x, s: jax.device_put(x, s), batch,
-                        self.shardings,
-                        is_leaf=lambda x: isinstance(x, np.ndarray))
-                    q.put(placed)
-            finally:
-                q.put(stop)
+        def produce():
+            for batch in self.input_iter_func():
+                yield jax.tree_util.tree_map(
+                    lambda x, s: jax.device_put(x, s), batch,
+                    self.shardings,
+                    is_leaf=lambda x: isinstance(x, np.ndarray))
 
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        yield from _prefetch_iter(produce, self.prefetch_size)
 
 
 class MeshDriverDataLoader(DataLoader):
@@ -74,6 +88,58 @@ class MeshDriverDataLoader(DataLoader):
             return input_iter_func(0, num_samples, batch_size)
 
         super().__init__(iter_func, placement_specs, prefetch_size)
+
+
+class DistributedDataLoader:
+    """Per-host shard loading (ref MeshWorkerDataLoader:229): every process
+    materializes ONLY the batch rows its addressable devices hold, via
+    ``jax.make_array_from_callback`` — no host ever sees the global batch.
+
+    ``row_loader(start, stop) -> np.ndarray`` returns rows [start, stop) of
+    the current batch; it is called once per addressable shard with that
+    shard's global row range.  Iterating the loader advances the epoch:
+    step k calls ``next_batch_fn(k) -> row_loader``.
+    """
+
+    def __init__(self,
+                 global_batch_shape: Sequence[int],
+                 sharding: Any,
+                 next_batch_fn: Callable[[int], Callable],
+                 num_batches: int,
+                 dtype=np.float32,
+                 prefetch_size: int = 2):
+        self.global_batch_shape = tuple(global_batch_shape)
+        self.sharding = sharding
+        self.next_batch_fn = next_batch_fn
+        self.num_batches = num_batches
+        self.dtype = dtype
+        self.prefetch_size = prefetch_size
+        self.rows_loaded = 0  # this process's loaded row count (telemetry)
+
+    def _make(self, step: int):
+        row_loader = self.next_batch_fn(step)
+
+        def cb(index):
+            # index: global ndarray index of one addressable shard
+            rows = index[0]
+            start = rows.start or 0
+            stop = (rows.stop if rows.stop is not None else
+                    self.global_batch_shape[0])
+            data = np.asarray(row_loader(start, stop), self.dtype)
+            self.rows_loaded += stop - start
+            rest = index[1:]
+            return data[(slice(None),) + tuple(rest)] if rest else data
+
+        return jax.make_array_from_callback(self.global_batch_shape,
+                                            self.sharding, cb)
+
+    def __iter__(self):
+
+        def produce():
+            for step in range(self.num_batches):
+                yield self._make(step)
+
+        yield from _prefetch_iter(produce, self.prefetch_size)
 
 
 def get_batch_shardings(executable, batch_argnums: Sequence[int] = (1,)):

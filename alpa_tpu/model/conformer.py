@@ -1,84 +1,228 @@
-"""Conformer encoder (speech) for the model zoo.
+"""Conformer encoder for speech (ASR) in the model zoo.
 
-Analog of ref ``alpa/model/conformer.py`` (314 LoC): conformer blocks =
-half-step FFN, multi-head self-attention with relative-ish positions,
-depthwise conv module, half-step FFN, all pre-norm with residuals.
+Analog of ref ``alpa/model/conformer.py`` (314 LoC): conv subsampling of
+the feature sequence, then conformer blocks = half-step FFN, multi-head
+self-attention with additive sinusoidal positional encoding and padding
+mask, depthwise conv module, half-step FFN, post-norm
+(ref ConformerLayer:245, MultiHeadSelfAttentionModule:158,
+ConvModule:123, FFNModule:100, ConvSubSample:72,
+ConformerForASRModule:277).
+
+TPU-first choices: fp32 LayerNorm/softmax over ``dtype`` activations;
+GroupNorm(1) instead of BatchNorm in the conv module (no cross-batch
+running stats to sync across data-parallel shards — per-timestep norm is
+the streaming-friendly, mesh-neutral choice); masks built with
+``broadcasted_iota`` so everything stays statically shaped under jit.
 """
 import dataclasses
-from typing import Any
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass(frozen=True)
 class ConformerConfig:
+    num_mel_bins: int = 80
     hidden_size: int = 256
     num_layers: int = 4
     num_heads: int = 4
     conv_kernel_size: int = 15
+    subsample_channels: int = 64
     ffn_ratio: int = 4
+    vocab_size: int = 1024          # ASR output vocabulary (CTC logits)
+    max_len: int = 2048             # positional-encoding table length
     dtype: Any = jnp.float32
     dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-5
+
+
+def sinusoidal_position_encoding(length: int, dim: int) -> jnp.ndarray:
+    """(length, dim) fixed sinusoid added pre-attention (ref :190)."""
+    pos = np.arange(length, dtype=np.float32)[:, None]
+    div = np.exp(np.arange(0, dim, 2, dtype=np.float32) *
+                 (-np.log(10000.0) / dim))
+    enc = np.zeros((length, dim), dtype=np.float32)
+    enc[:, 0::2] = np.sin(pos * div)
+    enc[:, 1::2] = np.cos(pos * div)
+    return jnp.asarray(enc)
+
+
+def lengths_to_mask(lengths, max_len: int) -> jnp.ndarray:
+    """(B,) valid lengths -> (B, max_len) bool mask, static shapes."""
+    pos = jax.lax.broadcasted_iota(jnp.int32, (max_len,), 0)
+    return pos[None, :] < lengths[:, None]
+
+
+class ConvSubSample(nn.Module):
+    """Two stride-2 2D convs over (time, mel) then linear projection:
+    (B, T, F) -> (B, T//4, H) with lengths scaled to match
+    (ref ConvSubSample:72)."""
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x, lengths=None):
+        cfg = self.config
+        if lengths is not None:
+            # zero pad frames BEFORE the convs: the stride-2 windows at
+            # the valid/pad boundary would otherwise mix pad garbage into
+            # the last valid subsampled frame
+            frame_mask = lengths_to_mask(lengths, x.shape[1])
+            x = jnp.where(frame_mask[:, :, None], x, jnp.zeros_like(x))
+        h = x[..., None].astype(cfg.dtype)           # (B, T, F, 1)
+        h = nn.Conv(cfg.subsample_channels, (3, 3), strides=(2, 2),
+                    dtype=cfg.dtype, name="conv1")(h)
+        h = nn.relu(h)
+        h = nn.Conv(cfg.subsample_channels, (3, 3), strides=(2, 2),
+                    dtype=cfg.dtype, name="conv2")(h)
+        h = nn.relu(h)
+        b, t, f, c = h.shape
+        h = h.reshape(b, t, f * c)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj")(h)
+        if lengths is not None:
+            # ceil-div twice: each stride-2 conv (SAME padding) halves T
+            lengths = (lengths + 1) // 2
+            lengths = (lengths + 1) // 2
+        return h, lengths
 
 
 class FeedForwardModule(nn.Module):
+    """Pre-norm swish FFN, used at half weight twice per block
+    (ref FFNModule:100)."""
     config: ConformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, deterministic=True):
         cfg = self.config
-        h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.Dense(cfg.ffn_ratio * cfg.hidden_size, dtype=cfg.dtype)(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32)(x)
+        h = nn.Dense(cfg.ffn_ratio * cfg.hidden_size,
+                     dtype=cfg.dtype)(h.astype(cfg.dtype))
         h = nn.swish(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(h)
-        return h
+        return nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
 
 
 class ConvModule(nn.Module):
+    """LN -> pointwise(2H) -> GLU -> depthwise conv -> LN -> swish ->
+    pointwise (ref ConvModule:123).  Padding positions are zeroed before
+    the depthwise conv so pad frames cannot leak into valid ones through
+    the kernel window.  The post-conv norm is a per-position LayerNorm
+    (the reference's BatchNorm carries cross-batch running stats that
+    would need syncing across data-parallel shards, and a time-reducing
+    GroupNorm would make valid frames depend on the batch's pad width —
+    per-position LN is the mesh-neutral, padding-invariant choice)."""
     config: ConformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask=None, deterministic=True):
         cfg = self.config
-        h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.Dense(2 * cfg.hidden_size, dtype=cfg.dtype)(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32)(x)
+        h = nn.Dense(2 * cfg.hidden_size,
+                     dtype=cfg.dtype)(h.astype(cfg.dtype))
         h = nn.glu(h, axis=-1)
-        # depthwise conv over time
+        if mask is not None:
+            h = jnp.where(mask[:, :, None], h, jnp.zeros_like(h))
         h = nn.Conv(cfg.hidden_size, (cfg.conv_kernel_size,),
                     feature_group_count=cfg.hidden_size,
                     dtype=cfg.dtype)(h)
-        h = nn.GroupNorm(num_groups=1, dtype=jnp.float32)(h)
-        h = nn.swish(h)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32)(h)
+        h = nn.swish(h).astype(cfg.dtype)
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype)(h)
-        return h
+        return nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+
+
+class MHSAModule(nn.Module):
+    """Pre-norm MHSA with additive sinusoidal positions and padding mask,
+    fp32 softmax (ref MultiHeadSelfAttentionModule:158)."""
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, x, pos_encoding, mask=None, deterministic=True):
+        cfg = self.config
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32)(x)
+        h = h.astype(cfg.dtype) + pos_encoding.astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.hidden_size, dtype=cfg.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s = h.shape[0], h.shape[1]
+        q = q.reshape(b, s, nh, hd)
+        k = k.reshape(b, s, nh, hd)
+        v = v.reshape(b, s, nh, hd)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / np.sqrt(hd)
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores,
+                               jnp.float32(-1e9))
+        probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(
+            b, s, cfg.hidden_size)
+        out = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="out")(out)
+        return nn.Dropout(cfg.dropout_rate)(out,
+                                            deterministic=deterministic)
 
 
 class ConformerBlock(nn.Module):
+    """ffn/2 + mhsa + conv + ffn/2 + final LN (ref ConformerLayer:245)."""
     config: ConformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos_encoding, mask=None, deterministic=True):
         cfg = self.config
-        x = x + 0.5 * FeedForwardModule(cfg, name="ffn1")(x)
-        h = nn.LayerNorm(dtype=jnp.float32)(x)
-        h = nn.MultiHeadDotProductAttention(num_heads=cfg.num_heads,
-                                            dtype=cfg.dtype)(h, h)
-        x = x + h
-        x = x + ConvModule(cfg, name="conv")(x)
-        x = x + 0.5 * FeedForwardModule(cfg, name="ffn2")(x)
-        return nn.LayerNorm(dtype=jnp.float32)(x)
+        x = x + 0.5 * FeedForwardModule(cfg, name="ffn1")(
+            x, deterministic)
+        x = x + MHSAModule(cfg, name="mhsa")(x, pos_encoding, mask,
+                                             deterministic)
+        x = x + ConvModule(cfg, name="conv")(x, mask, deterministic)
+        x = x + 0.5 * FeedForwardModule(cfg, name="ffn2")(
+            x, deterministic)
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps,
+                            dtype=jnp.float32)(x).astype(cfg.dtype)
 
 
 class Conformer(nn.Module):
-    """Encoder: (B, T, F) features -> (B, T, H) representations."""
+    """Encoder over projected features: (B, T, H) -> (B, T, H).
+
+    Accepts pre-subsampled inputs; ``ConformerForASR`` wires the conv
+    subsampling in front for raw (B, T, F) mel features."""
     config: ConformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lengths=None, deterministic=True):
         cfg = self.config
+        # always project: a shape-conditional layer would make the param
+        # tree depend on the input width (incompatible checkpoints)
         x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, name="proj_in")(x)
+        s = x.shape[1]
+        assert s <= cfg.max_len, (
+            f"sequence length {s} exceeds ConformerConfig.max_len "
+            f"{cfg.max_len}")
+        pos = sinusoidal_position_encoding(cfg.max_len,
+                                           cfg.hidden_size)[None, :s]
+        mask = None
+        if lengths is not None:
+            mask = lengths_to_mask(lengths, s)
         for i in range(cfg.num_layers):
-            x = ConformerBlock(cfg, name=f"block_{i}")(x)
+            x = ConformerBlock(cfg, name=f"block_{i}")(x, pos, mask,
+                                                       deterministic)
         return x
+
+
+class ConformerForASR(nn.Module):
+    """Subsample + encoder + CTC logits head: (B, T, F) mel features ->
+    ((B, T//4, vocab) log-probs, subsampled lengths)
+    (ref ConformerForASRModule:277)."""
+    config: ConformerConfig
+
+    @nn.compact
+    def __call__(self, features, lengths=None, deterministic=True):
+        cfg = self.config
+        x, lengths = ConvSubSample(cfg, name="subsample")(features, lengths)
+        x = Conformer(cfg, name="encoder")(x, lengths, deterministic)
+        logits = nn.Dense(cfg.vocab_size, dtype=cfg.dtype, name="head")(x)
+        log_probs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return log_probs, lengths

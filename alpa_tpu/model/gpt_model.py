@@ -77,17 +77,21 @@ def config_from_spec(name: str, **kwargs) -> GPTConfig:
                      **kwargs)
 
 
-def reference_attention(q, k, v, *, causal: bool, offset=0):
+def reference_attention(q, k, v, *, causal: bool, offset=0, bias=None):
     """Plain einsum attention; XLA fuses this well on TPU for short seqs.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D).  fp32 softmax accumulation.
     ``offset`` shifts query positions for decode-with-cache; a scalar
     applies to every row, a (B,) vector gives per-row offsets (mixed
-    prompt lengths in one continuously-batched decode).
+    prompt lengths in one continuously-batched decode).  ``bias`` is an
+    fp32 additive score bias broadcastable to (B, H, Sq, Sk) — e.g. a
+    padding mask for encoder models (BERT).
     """
     dim = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
     scores = scores / np.sqrt(dim)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
     if causal:
         sq, sk = q.shape[1], k.shape[1]
         offset = jnp.asarray(offset, jnp.int32)
@@ -120,7 +124,8 @@ class SelfAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, kv_cache=None, deterministic=True):
+    def __call__(self, x, kv_cache=None, deterministic=True,
+                 attn_bias=None):
         cfg = self.config
         h, nh = cfg.hidden_size, cfg.num_heads
         hd = h // nh
@@ -165,8 +170,14 @@ class SelfAttention(nn.Module):
             new_cache = (k_full, v_full, index + s)
             out = attn
         else:
-            attn_fn = get_attention_fn(cfg)
-            out = attn_fn(q, k, v, causal=cfg.causal)
+            if attn_bias is not None:
+                # additive padding/score bias: encoder path only (the
+                # flash/ring kernels take no bias operand)
+                out = reference_attention(q, k, v, causal=cfg.causal,
+                                          bias=attn_bias)
+            else:
+                attn_fn = get_attention_fn(cfg)
+                out = attn_fn(q, k, v, causal=cfg.causal)
         out = out.reshape(b, s, h)
         out = nn.Dense(h, dtype=cfg.dtype, name="out")(out)
         return out, new_cache
@@ -189,12 +200,13 @@ class TransformerBlock(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, kv_cache=None, deterministic=True):
+    def __call__(self, x, kv_cache=None, deterministic=True,
+                 attn_bias=None):
         cfg = self.config
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                            name="ln1")(x)
         attn_out, new_cache = SelfAttention(cfg, name="attn")(
-            ln1, kv_cache, deterministic)
+            ln1, kv_cache, deterministic, attn_bias)
         x = x + attn_out.astype(x.dtype)
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                            name="ln2")(x)

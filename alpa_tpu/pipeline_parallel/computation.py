@@ -84,7 +84,12 @@ def slice_closed_jaxpr_by_full_pipeline_marks(
                 alias[local] = outer
         elif is_marker(eqn, "end"):
             for local, outer in zip(eqn.invars, eqn.outvars):
-                if isinstance(local, Var):
+                # Do NOT overwrite an existing alias: if ``local`` came in
+                # through this layer's start marker (a passthrough), the
+                # start-marker alias must keep winning so inside uses
+                # resolve to the *incoming* outer var; the passthrough
+                # out-name is connected by an identity eqn at slicing.
+                if isinstance(local, Var) and local not in alias:
                     alias[local] = outer
 
     def resolve(v):
@@ -113,10 +118,19 @@ def slice_closed_jaxpr_by_full_pipeline_marks(
             continue
         if is_marker(eqn, "end"):
             assert current is not None, "end marker without start"
-            current.outvars = [
-                resolve(v) for v in eqn.outvars
-                if isinstance(resolve(v), Var)
-            ]
+            outvars = []
+            for local, outer in zip(eqn.invars, eqn.outvars):
+                out = resolve(outer)
+                if not isinstance(out, Var):
+                    continue
+                src = resolve(local)
+                if src is not out:
+                    # passthrough (src is the incoming outer var) or a
+                    # literal output: define the out-name inside the
+                    # computation so every declared outvar is produced
+                    current.eqns.append(_identity_eqn(src, out))
+                outvars.append(out)
+            current.outvars = outvars
             computations.append(current)
             current = None
             continue

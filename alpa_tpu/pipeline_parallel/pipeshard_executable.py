@@ -399,6 +399,22 @@ class PipeshardDriverExecutable:
                     sharding_at[(v, key[1], mesh_id)] = dst_sharding
                 location[key] = OrderedSet([m for m, _ in place_list])
             if mesh_id not in location[key]:
+                # ReplicatedDistributedArray analog (ref device_mesh.py:1697):
+                # a non-batch global input or const consumed by stages on
+                # several meshes (e.g. a tied embedding table used by both
+                # the first and last stage) is placed on EACH mesh directly
+                # from the host at launch — one logical tensor, multiple
+                # residencies — instead of a serialized cross-mesh hop.
+                replicable = (v in self.consts_map or
+                              (v in ginvar_idx and v not in batch_var))
+                if replicable and v not in self.acc_pairs:
+                    place_list = (self.input_place if v in ginvar_idx else
+                                  self.const_place).setdefault(v, [])
+                    if mesh_id not in [m for m, _ in place_list]:
+                        place_list.append((mesh_id, dst_sharding))
+                    location[key].add(mesh_id)
+                    sharding_at[(v, key[1], mesh_id)] = dst_sharding
+                    return
                 src = next(iter(location[key]))
                 inst = PipelineInstruction(PipelineInstType.RESHARD,
                                            var_key=key, src_mesh=src,

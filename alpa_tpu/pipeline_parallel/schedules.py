@@ -101,12 +101,15 @@ class PipeDreamFlush(PipelineSchedule):
     bounded activation memory (at most `m - mesh_idx` in-flight
     microbatches per mesh)."""
 
+    def _warmup_depth(self, mesh_idx: int) -> int:
+        return self.num_meshes - mesh_idx - 1
+
     def _generate_schedule(self):
         m, n = self.num_meshes, self.num_batch
         # per-mesh operation list: ('F'|'B', microbatch)
         per_mesh_ops: List[List[Tuple[str, int]]] = []
         for d in range(m):
-            warmup = min(m - d - 1, n)
+            warmup = min(self._warmup_depth(d), n)
             ops = [("F", i) for i in range(warmup)]
             fwd_i, bwd_i = warmup, 0
             # steady 1F1B
@@ -157,6 +160,18 @@ class PipeDreamFlush(PipelineSchedule):
         return schedules
 
 
+class OverlapFriendlyPipeDreamSchedule(PipeDreamFlush):
+    """1F1B with a doubled warmup depth (ref
+    OverlapFriendlyPipeDreamSchedule, schedules.py:452): each mesh runs up
+    to ``2*(m - d) - 1`` forward microbatches before its first backward, so
+    more cross-mesh activations are in flight at once — the async dispatch
+    queue (the reference: NCCL sends) gets more transfers to overlap with
+    compute.  Trade-off: proportionally more live activation memory."""
+
+    def _warmup_depth(self, mesh_idx: int) -> int:
+        return 2 * (self.num_meshes - mesh_idx) - 1
+
+
 class InferenceSchedule(PipelineSchedule):
     """Forward-only pipelined batches (ref schedules.py:393)."""
 
@@ -181,12 +196,13 @@ def create_pipeline_schedule(name: str, *, num_stages: int, num_meshes: int,
                              num_batch: int) -> PipelineSchedule:
     """(ref schedules.py:528)"""
     if name == "1f1b_overlap_friendly":
-        # The reference reorders sends by producer order so NCCL comm
-        # overlaps compute (ref OverlapFriendlyPipeDreamSchedule:452 +
-        # emitter :1109).  Here dispatch is already fully asynchronous and
-        # XLA/the jax runtime overlap transfers with compute, so the plain
-        # 1F1B tick order is already overlap-friendly.
-        name = "1f1b"
+        # The reference also reorders sends by producer order so NCCL comm
+        # overlaps compute (emitter :1109); here dispatch is already fully
+        # asynchronous (the jax runtime overlaps transfers with compute),
+        # so only the schedule half — eager forwards — carries over.
+        return OverlapFriendlyPipeDreamSchedule(num_stages=num_stages,
+                                                num_meshes=num_meshes,
+                                                num_batch=num_batch)
     if name == "gpipe":
         return GpipeSchedule(num_stages=num_stages, num_meshes=num_meshes,
                              num_batch=num_batch)

@@ -9,3 +9,4 @@ from alpa_tpu.serve.generation import GenerationConfig, Generator, get_model
 from alpa_tpu.serve.controller import (Controller, RequestBatcher,
                                        run_controller)
 from alpa_tpu.serve.engine import ContinuousBatchingEngine
+from alpa_tpu.serve.hf_wrapper import WrappedInferenceModel, get_hf_model

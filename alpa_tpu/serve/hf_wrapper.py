@@ -1,0 +1,127 @@
+"""HuggingFace-compatible inference wrapper.
+
+Analog of ref ``examples/llm_serving/model/wrapper.py:501`` (``get_model``
+returning an HF ``GenerationMixin``-compatible object): an HF user calls
+``model.generate(input_ids=..., max_new_tokens=..., do_sample=...,
+num_beams=...)`` exactly as with ``transformers`` and gets token arrays
+back, while prefill/decode run as compiled alpa_tpu executables with
+resident KV caches (greedy / sampling / beam search all ride the
+``Generator``'s bucketed executables).
+"""
+import dataclasses
+import logging
+from typing import Any, Optional
+
+import numpy as np
+
+from alpa_tpu.serve.generation import GenerationConfig, Generator
+
+logger = logging.getLogger(__name__)
+
+
+class WrappedInferenceModel:
+    """Duck-typed HF model front: ``generate`` + ``config`` (ref
+    WrappedInferenceFunc, wrapper.py:70)."""
+
+    def __init__(self, generator: Generator, eos_token_id: Optional[int] = None):
+        self.generator = generator
+        self.eos_token_id = eos_token_id
+        self.config = generator.config
+
+    def generate(self,
+                 input_ids=None,
+                 attention_mask=None,
+                 max_new_tokens: int = 32,
+                 max_length: Optional[int] = None,
+                 do_sample: bool = False,
+                 temperature: float = 1.0,
+                 top_k: int = 0,
+                 num_beams: int = 1,
+                 length_penalty: float = 1.0,
+                 eos_token_id: Optional[int] = None,
+                 pad_token_id: Optional[int] = None,
+                 seed: int = 0,
+                 **unused_kwargs) -> np.ndarray:
+        """HF-``GenerationMixin``-shaped generate.
+
+        ``input_ids``: (B, S) int array (torch tensors accepted).
+        ``attention_mask``: optional (B, S) 1/0 — right-padded rows decode
+        from their true lengths (mixed-length batching).
+        Returns (B, S + T) int array like ``transformers``.
+        """
+        if unused_kwargs:
+            logger.warning("generate: ignoring unsupported kwargs %s",
+                           sorted(unused_kwargs))
+        ids = _to_numpy(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None]
+        eos = eos_token_id if eos_token_id is not None else self.eos_token_id
+        if max_length is not None:
+            max_new_tokens = max(0, max_length - ids.shape[1])
+
+        if num_beams > 1:
+            assert ids.shape[0] == 1, (
+                "beam search supports batch size 1 (ref wrapper.py beam "
+                "path)")
+            if attention_mask is not None:
+                # trim trailing pads so the beam never conditions on them
+                n = int(_to_numpy(attention_mask).astype(np.int64).sum())
+                ids = ids[:, :n]
+            return self.generator.generate_beam(
+                ids, num_beams=num_beams, max_new_tokens=max_new_tokens,
+                length_penalty=length_penalty, eos_token_id=eos)
+
+        cfg = GenerationConfig(max_new_tokens=max_new_tokens,
+                               do_sample=do_sample, temperature=temperature,
+                               top_k=top_k, eos_token_id=eos)
+        import jax
+        rng = jax.random.PRNGKey(seed)
+        if attention_mask is not None:
+            mask = _to_numpy(attention_mask)
+            lengths = mask.astype(np.int64).sum(axis=1)
+            prompts = [ids[i, :lengths[i]] for i in range(ids.shape[0])]
+            outs = self.generator.generate(prompts, cfg, rng)
+            if isinstance(outs, np.ndarray):
+                return outs
+            # re-pad mixed-length rows into one (B, max) matrix, HF-style
+            pad = pad_token_id if pad_token_id is not None else (eos or 0)
+            width = max(len(o) for o in outs)
+            mat = np.full((len(outs), width), pad, np.int32)
+            for i, o in enumerate(outs):
+                mat[i, :len(o)] = o
+            return mat
+        return np.asarray(self.generator.generate(ids, cfg, rng))
+
+    def __call__(self, input_ids, **_):
+        """One forward pass returning logits (HF-model shape)."""
+        import jax.numpy as jnp
+        ids = jnp.asarray(_to_numpy(input_ids))
+        return self.generator.model.apply(self.generator.params, ids)
+
+
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):          # torch tensor
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def get_hf_model(model_name_or_model,
+                 dtype=None,
+                 shardings=None,
+                 eos_token_id: Optional[int] = None,
+                 prompt_buckets=None) -> WrappedInferenceModel:
+    """Load an HF GPT-2-family checkpoint into a servable wrapper
+    (ref get_model, wrapper.py:501 + distributed loading opt_model.py:956).
+
+    ``shardings``: optional params-pytree of NamedShardings — weights
+    device_put directly into their target shards (no full replica per
+    device)."""
+    import jax.numpy as jnp
+
+    from alpa_tpu.model.weight_loading import load_gpt2
+
+    model, params, config = load_gpt2(model_name_or_model,
+                                      dtype=dtype or jnp.float32,
+                                      shardings=shardings)
+    gen = Generator(model, params, config, prompt_buckets=prompt_buckets)
+    return WrappedInferenceModel(gen, eos_token_id=eos_token_id)

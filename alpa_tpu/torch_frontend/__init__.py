@@ -9,6 +9,15 @@ params pytree (the module's state_dict), which then goes through
 from alpa_tpu.torch_frontend.converter import (fx_to_jax,
                                                torch_to_jax_array)
 from alpa_tpu.torch_frontend.converter import functionalize as _functionalize
+from alpa_tpu.torch_frontend import optim
+
+
+def __getattr__(name):
+    # lazy: trainer pulls in alpa_tpu.api (heavier import)
+    if name in ("TorchTrainer", "TrainState"):
+        from alpa_tpu.torch_frontend import trainer
+        return getattr(trainer, name)
+    raise AttributeError(name)
 
 _mode = "local"
 

@@ -23,7 +23,10 @@ logger = logging.getLogger(__name__)
 
 
 def torch_to_jax_array(t):
-    return jnp.asarray(t.detach().cpu().numpy())
+    # np.array (not asarray): tensor.numpy() SHARES the torch storage and
+    # jax's CPU backend can zero-copy it — later in-place torch mutation
+    # (e.g. torch optimizer steps) would silently change the jax array
+    return jnp.asarray(np.array(t.detach().cpu().numpy()))
 
 
 ########################################

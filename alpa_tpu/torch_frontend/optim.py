@@ -1,0 +1,83 @@
+"""Functional optimizers over the torch-frontend params dict.
+
+Analog of ref ``alpa/torch/optim/adam.py`` (which ships a placeholder —
+"TODO FIXME: properly implement Adam"; this is the real algorithm).  Each
+factory returns ``optim_gen(params) -> (optim_func, init_func, state)``
+matching the reference's functional contract:
+
+  optim_func(params, optim_state, grads) -> (params, optim_state)
+
+with no in-place ops and no data-dependent control flow, so the whole
+update jit-compiles into the train step.
+"""
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def adam(lr=1e-4, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0):
+    """Adam / AdamW (decoupled decay when ``weight_decay`` > 0)."""
+
+    def optim_gen(params: Dict[str, Any]):
+
+        def init_func(optim_state):
+            del optim_state
+            zeros = {
+                k: jnp.zeros(jnp.shape(v),
+                             jnp.result_type(v) if jnp.issubdtype(
+                                 jnp.result_type(v), jnp.floating)
+                             else jnp.float32)
+                for k, v in params.items()
+            }
+            return {
+                "step": jnp.zeros((), jnp.int32),
+                "mu": zeros,
+                "nu": {k: jnp.zeros_like(v) for k, v in zeros.items()},
+            }
+
+        def optim_func(params, optim_state, grads):
+            step = optim_state["step"] + 1
+            t = step.astype(jnp.float32)
+            new_mu, new_nu, new_params = {}, {}, {}
+            for k, p in params.items():
+                g = grads[k]
+                mu = b1 * optim_state["mu"][k] + (1 - b1) * g
+                nu = b2 * optim_state["nu"][k] + (1 - b2) * (g * g)
+                mu_hat = mu / (1 - b1**t)
+                nu_hat = nu / (1 - b2**t)
+                update = mu_hat / (jnp.sqrt(nu_hat) + eps)
+                if weight_decay:
+                    update = update + weight_decay * p
+                new_params[k] = p - lr * update
+                new_mu[k] = mu
+                new_nu[k] = nu
+            return new_params, {"step": step, "mu": new_mu, "nu": new_nu}
+
+        return optim_func, init_func, init_func(None)
+
+    return optim_gen
+
+
+def sgd(lr=1e-2, momentum=0.0):
+
+    def optim_gen(params: Dict[str, Any]):
+
+        def init_func(optim_state):
+            del optim_state
+            return {k: jnp.zeros_like(v) for k, v in params.items()}
+
+        def optim_func(params, optim_state, grads):
+            new_params, new_state = {}, {}
+            for k, p in params.items():
+                if momentum:
+                    buf = momentum * optim_state[k] + grads[k]
+                else:
+                    buf = grads[k]
+                new_state[k] = buf
+                new_params[k] = p - lr * buf
+            return new_params, new_state
+
+        return optim_func, init_func, init_func(None)
+
+    return optim_gen

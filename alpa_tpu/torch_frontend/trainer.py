@@ -1,0 +1,70 @@
+"""Training loop over a functionalized torch module.
+
+Analog of ref ``alpa/torch/trainer.py`` (``train_torch_module``): wire a
+``torch.nn.Module``, a functional optimizer (``torch_frontend.optim``),
+and a parallel method into one compiled train step; the user's code stays
+pure PyTorch.
+"""
+import collections
+import logging
+from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+TrainState = collections.namedtuple("TrainState", ["params", "optim_state"])
+
+
+class TorchTrainer:
+    """(ref trainer.py:23 train_torch_module, as a reusable object)
+
+    ``loss_func(out, target) -> scalar`` operates on jax arrays.
+    ``method``: any alpa_tpu ParallelMethod (None = ShardParallel).
+    """
+
+    def __init__(self, module, loss_func: Callable, optim_gen,
+                 method: Optional[Any] = None, concrete_args=None):
+        import alpa_tpu
+        from alpa_tpu.torch_frontend import functionalize
+
+        self.fn, params = functionalize(module, concrete_args)
+        optim_func, _init, optim_state = optim_gen(params)
+        self.state = TrainState(params, optim_state)
+        fn = self.fn
+
+        def train_step(state, batch):
+            inputs, target = batch
+
+            def compute_loss(p):
+                out = fn(p, inputs)
+                return loss_func(out, target)
+
+            loss, grads = alpa_tpu.value_and_grad(compute_loss)(
+                state.params)
+            params2, optim2 = optim_func(state.params, state.optim_state,
+                                         grads)
+            return TrainState(params2, optim2), loss
+
+        method = method or alpa_tpu.ShardParallel()
+        self.train_step = alpa_tpu.parallelize(train_step, method=method,
+                                               batch_argnums=(1,))
+
+    def step(self, inputs, target) -> float:
+        """One parallel train step; returns the loss value."""
+        import jax.numpy as jnp
+
+        from alpa_tpu.torch_frontend.converter import torch_to_jax_array
+
+        if hasattr(inputs, "detach"):
+            inputs = torch_to_jax_array(inputs)
+        if hasattr(target, "detach"):
+            target = torch_to_jax_array(target)
+        self.state, loss = self.train_step(self.state, (inputs, target))
+        return float(loss)
+
+    def fit(self, dataloader, num_epochs: int = 1):
+        """(ref train_torch_module's loop)"""
+        losses = []
+        for _ in range(num_epochs):
+            for inputs, target in dataloader:
+                losses.append(self.step(inputs, target))
+        return losses

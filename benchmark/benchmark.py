@@ -115,6 +115,18 @@ def build_case(case):
                 layer_num=case.method_kwargs.get("layer_num", 2)),
             stage_option=alpa_tpu.UniformStageOption(
                 case.method_kwargs.get("num_stages")))
+    elif case.method == "auto_pipeshard":
+        # full auto inter+intra search (ref suite_auto_*.py): OSDI'22
+        # stage DP over submesh choices, per-stage ILP inside
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            AutoStageOption)
+        method = alpa_tpu.PipeshardParallel(
+            num_micro_batches=case.num_micro_batches,
+            layer_option=alpa_tpu.AutoLayerOption(
+                layer_num=case.method_kwargs.get("layer_num", 2)),
+            stage_option=AutoStageOption(
+                profiling_database_filename=case.method_kwargs.get(
+                    "prof_db")))
     elif case.method == "dp":
         method = alpa_tpu.DataParallel(
             num_micro_batches=case.num_micro_batches)

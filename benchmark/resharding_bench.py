@@ -1,0 +1,107 @@
+"""Cross-mesh resharding microbenchmark.
+
+Analog of ref ``benchmark/alpa/resharding/`` (send/recv vs broadcast
+microbenchmarks over NCCL): times every execution mode of
+``ReshardingTask`` — runtime-carried ``device_put``, per-tile routed
+``tiled`` transfers, and ``broadcast`` fan-out — across a matrix of
+(shape, src sharding, dst sharding) cases, and reports planned vs
+executed bytes and effective bandwidth.
+
+Runs anywhere: on a virtual CPU mesh (default; set
+``--devices N`` to force ``xla_force_host_platform_device_count``) or on
+a real multi-chip TPU slice.
+
+Usage:
+  python benchmark/resharding_bench.py [--devices 8] [--mb 64]
+"""
+import argparse
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--devices", type=int, default=8,
+                        help="virtual CPU device count (ignored on TPU)")
+    parser.add_argument("--mb", type=int, default=16,
+                        help="approx tensor size in MB")
+    parser.add_argument("--niter", type=int, default=5)
+    parser.add_argument("--dump", default="resharding_results.tsv")
+    args = parser.parse_args()
+
+    if os.environ.get("JAX_PLATFORMS") != "tpu":
+        # default to the virtual CPU mesh; pass JAX_PLATFORMS=tpu to
+        # bench a real multi-chip slice
+        from alpa_tpu.platform import pin_cpu_platform
+        pin_cpu_platform(args.devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+        ReshardingTask, plan_resharding)
+    from alpa_tpu.util import write_tsv
+
+    devices = jax.devices()
+    n = len(devices)
+    assert n >= 4, f"need >= 4 devices, have {n}"
+    half = n // 2
+    src_mesh = Mesh(np.array(devices[:half]), ("d",))
+    dst_mesh = Mesh(np.array(devices[half:]), ("d",))
+
+    # rows*cols float32 ~= args.mb MB
+    rows = max(half * 4, int((args.mb * 1e6 / 4) ** 0.5) // 8 * 8)
+    cols = rows
+    shape = (rows, cols)
+    x = jnp.arange(rows * cols, dtype=jnp.float32).reshape(shape)
+
+    cases = [
+        # (name, src spec, dst spec)
+        ("rowshard->rowshard", P("d", None), P("d", None)),
+        ("rowshard->colshard", P("d", None), P(None, "d")),
+        ("rowshard->replicated", P("d", None), P(None, None)),
+        ("replicated->rowshard", P(None, None), P("d", None)),
+        ("colshard->rowshard", P(None, "d"), P("d", None)),
+    ]
+
+    for name, src_spec, dst_spec in cases:
+        src_sh = NamedSharding(src_mesh, src_spec)
+        dst_sh = NamedSharding(dst_mesh, dst_spec)
+        src = jax.device_put(x, src_sh)
+        plan = plan_resharding(shape, 4, src_sh, dst_sh)
+        for mode in ("device_put", "tiled", "broadcast"):
+            task = ReshardingTask(plan, dst_sh, mode)
+            out = task.run(src)          # warmup / correctness
+            jax.block_until_ready(out)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+            tic = time.perf_counter()
+            for _ in range(args.niter):
+                out = task.run(src)
+                jax.block_until_ready(out)
+            dt = (time.perf_counter() - tic) / args.niter
+            rep = task.last_report
+            moved = (rep.cross_mesh_bytes
+                     if rep and rep.mode != "device_put"
+                     else plan.transfer_bytes)
+            row = {
+                "case": name,
+                "mode": mode,
+                "planned_MB": round(plan.transfer_bytes / 1e6, 2),
+                "moved_MB": round(moved / 1e6, 2),
+                "intra_MB": round(rep.intra_mesh_bytes / 1e6, 2)
+                            if rep else 0.0,
+                "ms": round(dt * 1e3, 2),
+                "GBps": round(moved / dt / 1e9, 2),
+                "allgather_rewrite": plan.allgather_rewrite,
+            }
+            write_tsv(list(row.keys()), list(row.values()), args.dump)
+
+
+if __name__ == "__main__":
+    main()

@@ -83,4 +83,36 @@ suites = {
                       dict(num_layers=50, width_factor=2, num_classes=1000),
                       batch_size=32, dtype="float32"),
     ],
+    # ---- auto-search suites (ref suite_auto_gpt.py / suite_auto_moe.py /
+    # suite_wresnet.py): stage DP + per-stage ILP pick the plan ----
+    "gpt.auto": [
+        _gpt("gpt-125M-auto4", "125M", 16, nmb=4, method="auto_pipeshard",
+             layer_num=4),
+    ],
+    "gpt.auto_micro": [
+        # CPU-runnable: exercises the full auto path (profiling DB -> stage
+        # DP -> ILP) on a toy model
+        BenchmarkCase("gpt-micro-auto", "gpt",
+                      dict(hidden_size=64, num_layers=4, num_heads=4,
+                           seq_len=64, vocab_size=256),
+                      batch_size=8, num_micro_batches=2,
+                      method="auto_pipeshard",
+                      method_kwargs=dict(layer_num=4), dtype="float32"),
+    ],
+    "moe.auto": [
+        BenchmarkCase("moe-8e-auto", "moe",
+                      dict(hidden_size=512, num_layers=8, num_heads=8,
+                           seq_len=512, vocab_size=32000, num_experts=8,
+                           expert_group_size=2048, moe_every=2),
+                      batch_size=16, num_micro_batches=2,
+                      method="auto_pipeshard",
+                      method_kwargs=dict(layer_num=4)),
+    ],
+    "wresnet.auto": [
+        BenchmarkCase("wresnet50-w2-auto", "wresnet",
+                      dict(num_layers=50, width_factor=2, num_classes=1000),
+                      batch_size=32, num_micro_batches=2,
+                      method="auto_pipeshard",
+                      method_kwargs=dict(layer_num=2), dtype="float32"),
+    ],
 }

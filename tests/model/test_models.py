@@ -100,6 +100,63 @@ class TestBert:
         assert not np.allclose(np.asarray(logits[:, 0]),
                                np.asarray(logits2[:, 0]))
 
+    def test_attention_mask_blocks_padding(self):
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, seq_len=16)
+        model = BertForMaskedLM(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (2, 16), 0, 64)
+        mask = jnp.concatenate([jnp.ones((2, 12), jnp.int32),
+                                jnp.zeros((2, 4), jnp.int32)], axis=1)
+        params = model.init(rng, ids, mask)
+        base = model.apply(params, ids, mask)
+        # changing tokens under the padding mask must not change valid
+        # positions' logits
+        ids2 = ids.at[:, -1].set((ids[:, -1] + 7) % 64)
+        out2 = model.apply(params, ids2, mask)
+        np.testing.assert_allclose(np.asarray(base[:, :12]),
+                                   np.asarray(out2[:, :12]),
+                                   rtol=1e-6, atol=1e-6)
+        # without the mask they do change (sanity)
+        out3 = model.apply(params, ids2)
+        assert not np.allclose(np.asarray(base[:, :12]),
+                               np.asarray(out3[:, :12]))
+
+    def test_pretraining_heads_and_loss(self):
+        from alpa_tpu.model.bert_model import (BertForPreTraining,
+                                               bert_pretraining_loss)
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, seq_len=16, tie_word_embeddings=True)
+        model = BertForPreTraining(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (4, 16), 0, 64)
+        params = model.init(rng, ids)
+        # tied decoder: no separate (H, V) decoder kernel in the tree
+        flat = jax.tree_util.tree_leaves_with_path(params)
+        assert not any("decoder/" in jax.tree_util.keystr(p).replace(
+            "']['", "/") and l.ndim == 2 for p, l in flat)
+        mlm_logits, nsp_logits = model.apply(params, ids)
+        assert mlm_logits.shape == (4, 16, 64)
+        assert nsp_logits.shape == (4, 2)
+
+        mlm_labels = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                        64)
+        mlm_weights = (jax.random.uniform(jax.random.PRNGKey(2),
+                                          (4, 16)) < 0.15).astype(
+                                              jnp.float32)
+        nsp_labels = jnp.array([0, 1, 0, 1])
+
+        def loss_fn(p):
+            ml, nl = model.apply(p, ids)
+            return bert_pretraining_loss(ml, nl, mlm_labels, mlm_weights,
+                                         nsp_labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        # the tied embedding table receives gradient from the MLM head
+        g_emb = grads["params"]["bert"]["word_embeddings"]["embedding"]
+        assert float(jnp.abs(g_emb).max()) > 0
+
 
 class TestWideResNet:
 
@@ -150,6 +207,96 @@ class TestUNetAndConformer:
         g = jax.grad(lambda p: (model.apply(p, x, t)**2).mean())(params)
         assert np.isfinite(float(
             jax.tree_util.tree_leaves(g)[0].sum()))
+
+    def test_unet_condition_model(self):
+        from alpa_tpu.model.unet_2d import (UNet2DConditionModel,
+                                            UNetConditionConfig)
+        cfg = UNetConditionConfig(in_channels=4, out_channels=4,
+                                  block_out_channels=(16, 32),
+                                  down_block_types=("CrossAttnDownBlock2D",
+                                                    "DownBlock2D"),
+                                  layers_per_block=1, attention_head_dim=8,
+                                  cross_attention_dim=24)
+        model = UNet2DConditionModel(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (2, 16, 16, 4))
+        t = jnp.array([3, 11])
+        ctx = jax.random.normal(jax.random.PRNGKey(1), (2, 6, 24))
+        params = model.init(rng, x, t, ctx)
+        out = model.apply(params, x, t, ctx)
+        assert out.shape == (2, 16, 16, 4)
+        # conditioning actually conditions: different context, different out
+        out2 = model.apply(params, x, t, ctx + 1.0)
+        assert not np.allclose(np.asarray(out), np.asarray(out2))
+        g = jax.grad(lambda p: (model.apply(p, x, t, ctx)**2).mean())(
+            params)
+        assert np.isfinite(float(jax.tree_util.tree_leaves(g)[0].sum()))
+
+    def test_unet_auto_sharding_nontrivial(self):
+        """The intra-op planner picks a non-trivial (parallel) strategy
+        for the UNet's convs on an 8-device mesh (VERDICT r1 next#9)."""
+        from alpa_tpu.model.unet_2d import UNet2D, UNetConfig
+        from alpa_tpu.util import count_communication_primitives
+        cfg = UNetConfig(block_channels=(16, 32), layers_per_block=1,
+                         attention_resolutions=(), num_heads=2,
+                         time_embed_dim=32)
+        model = UNet2D(cfg)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.normal(rng, (16, 16, 16, 3))
+        t = jnp.arange(16)
+        params = model.init(rng, x, t)
+        state = train_state.TrainState.create(apply_fn=model.apply,
+                                              params=params,
+                                              tx=optax.sgd(1e-2))
+
+        @alpa_tpu.parallelize(method=ShardParallel())
+        def step(state, batch):
+
+            def loss_fn(p):
+                out = state.apply_fn(p, batch["x"], batch["t"])
+                return (out**2).mean()
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            return state.apply_gradients(grads=grads), loss
+
+        s, l = step(state, {"x": x, "t": t})
+        assert np.isfinite(float(l))
+        hlo = step.get_last_executable().get_hlo_text()
+        total, ar, ag, rs, a2a = count_communication_primitives(hlo)
+        assert total > 0, "UNet compiled with no parallelism at all"
+
+    def test_conformer_asr_with_lengths(self):
+        from alpa_tpu.model.conformer import (ConformerConfig,
+                                              ConformerForASR)
+        cfg = ConformerConfig(num_mel_bins=20, hidden_size=64,
+                              num_layers=2, num_heads=4,
+                              conv_kernel_size=7, vocab_size=30)
+        model = ConformerForASR(cfg)
+        rng = jax.random.PRNGKey(0)
+        feats = jax.random.normal(rng, (4, 64, 20))
+        lengths = jnp.array([64, 48, 32, 16])
+        params = model.init(rng, feats, lengths)
+        log_probs, out_lens = model.apply(params, feats, lengths)
+        assert log_probs.shape == (4, 16, 30)     # T subsampled 4x
+        assert list(np.asarray(out_lens)) == [16, 12, 8, 4]
+        # log-probs normalized
+        np.testing.assert_allclose(
+            np.asarray(jnp.exp(log_probs).sum(-1)), 1.0, rtol=1e-3)
+        # padding invariance: corrupting frames past a row's length must
+        # not change its valid outputs
+        feats2 = feats.at[1, 48:].set(99.0)
+        lp2, _ = model.apply(params, feats2, lengths)
+        np.testing.assert_allclose(np.asarray(log_probs[1, :12]),
+                                   np.asarray(lp2[1, :12]), rtol=1e-4,
+                                   atol=1e-4)
+        # pad-WIDTH invariance: the same audio padded to a different batch
+        # width must give the same valid log-probs (no norm reading stats
+        # off the time axis)
+        solo = jnp.zeros((1, 32, 20)).at[0, :].set(feats[2, :32])
+        lp_solo, _ = model.apply(params, solo, jnp.array([32]))
+        np.testing.assert_allclose(np.asarray(log_probs[2, :8]),
+                                   np.asarray(lp_solo[0, :8]), rtol=1e-4,
+                                   atol=1e-4)
 
     def test_conformer_forward_parallel(self):
         from alpa_tpu.model.conformer import Conformer, ConformerConfig

@@ -72,6 +72,18 @@ class TestFollowPipeshard:
             return state.apply_gradients(grads=grads), loss
 
         state_p, loss_p = train_step(state, batch)
+        # ReplicatedDistributedArray role (ref device_mesh.py:1697): the
+        # tied table is one logical tensor placed on BOTH the embedding
+        # mesh and the lm-head mesh.
+        t_ex = train_step.get_last_executable()
+        multi_mesh = [v for v, places in t_ex.input_place.items()
+                      if len(places) >= 2]
+        assert multi_mesh, "no input replicated across meshes"
+        emb_shape = np.asarray(
+            state.params["params"]["wte"]["embedding"]).shape
+        assert any(tuple(v.aval.shape) == emb_shape for v in multi_mesh), (
+            f"tied embedding table not multi-mesh resident: "
+            f"{[tuple(v.aval.shape) for v in multi_mesh]}")
         state_s, loss_s = serial_step(state, batch)
         assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
         assert_allclose(jax.device_get(state_s.params),

@@ -53,6 +53,13 @@ class TestPipeshard:
                               stage_option=UniformStageOption(num_stages=2),
                               pipeline_schedule="gpipe"))
 
+    def test_1f1b_overlap_friendly(self):
+        _compare_pipeshard(
+            PipeshardParallel(num_micro_batches=4,
+                              layer_option=ManualLayerOption(),
+                              stage_option=UniformStageOption(num_stages=2),
+                              pipeline_schedule="1f1b_overlap_friendly"))
+
     def test_auto_layers(self):
         _compare_pipeshard(
             PipeshardParallel(num_micro_batches=2,
@@ -235,6 +242,63 @@ class TestPipeshardInference:
 
         with pytest.raises(ValueError, match="scalar output"):
             mean_out(state, batch)
+
+
+class TestFourStageGPT:
+
+    def test_four_stages_marker_passthrough(self):
+        """Regression: a value passing through a layer's start AND end
+        marker untouched (common in >2-stage transformers: cotangents and
+        residuals riding through middle layers) must stay connected —
+        the slicer emits an identity eqn for passthrough pairs.  Before
+        the fix this raised KeyError at stage compile (phantom outvar)."""
+        import optax
+        from flax.training import train_state
+
+        from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
+        from alpa_tpu.model.model_util import cross_entropy_loss
+
+        alpa_tpu.init(cluster="local")
+        cfg = GPTConfig(hidden_size=64, num_layers=4, num_heads=4,
+                        seq_len=32, vocab_size=128)
+        model = GPTModel(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (8, 32), 0, 128)
+        labels = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
+        params = model.init(rng, ids)
+        state = train_state.TrainState.create(apply_fn=model.apply,
+                                              params=params,
+                                              tx=optax.adam(1e-3))
+        batch = {"ids": ids, "labels": labels}
+
+        def step_fn(parallel):
+            def train_step(state, batch):
+                loss, grads = alpa_tpu.value_and_grad(
+                    lambda p: cross_entropy_loss(
+                        state.apply_fn(p, batch["ids"]).astype(jnp.float32),
+                        batch["labels"]))(state.params)
+                return state.apply_gradients(grads=grads), loss
+            if parallel:
+                return alpa_tpu.parallelize(
+                    train_step,
+                    method=PipeshardParallel(
+                        num_micro_batches=2,
+                        layer_option=AutoLayerOption(layer_num=4),
+                        stage_option=UniformStageOption(num_stages=4)))
+            return jax.jit(lambda s, b: (
+                s.apply_gradients(grads=jax.grad(
+                    lambda p: cross_entropy_loss(
+                        s.apply_fn(p, b["ids"]).astype(jnp.float32),
+                        b["labels"]))(s.params)),
+                cross_entropy_loss(
+                    s.apply_fn(s.params, b["ids"]).astype(jnp.float32),
+                    b["labels"])))
+
+        state_p, loss_p = step_fn(True)(state, batch)
+        state_s, loss_s = step_fn(False)(state, batch)
+        assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
+        assert_allclose(jax.device_get(state_s.params),
+                        jax.device_get(state_p.params), 2e-3, 2e-3)
 
 
 class TestAutoStage:

@@ -2,10 +2,9 @@
 import numpy as np
 import pytest
 
-from alpa_tpu.pipeline_parallel.schedules import (GpipeSchedule,
-                                                  InferenceSchedule,
-                                                  PipeDreamFlush,
-                                                  create_pipeline_schedule)
+from alpa_tpu.pipeline_parallel.schedules import (
+    GpipeSchedule, InferenceSchedule, OverlapFriendlyPipeDreamSchedule,
+    PipeDreamFlush, create_pipeline_schedule)
 
 
 def _check_complete(sched, num_meshes, num_batch, has_backward=True):
@@ -65,12 +64,41 @@ class TestSchedules:
                 max_in_flight = max(max_in_flight, in_flight)
         assert max_in_flight <= m, max_in_flight
 
+    @pytest.mark.parametrize("m,n", [(2, 4), (4, 8), (3, 5)])
+    def test_overlap_friendly_complete(self, m, n):
+        s = OverlapFriendlyPipeDreamSchedule(num_stages=2 * m, num_meshes=m,
+                                             num_batch=n)
+        _check_complete(s, m, n)
+
+    def test_overlap_friendly_deeper_warmup(self):
+        """Mesh 0 runs more forwards before its first backward than plain
+        1F1B (the eager-forward overlap window, ref schedules.py:452)."""
+        m, n = 4, 16
+
+        def warmup_len(sched):
+            count = 0
+            for tick in sched.schedules:
+                t = tick[0]
+                if t is None:
+                    continue
+                if t[1] == 0:
+                    count += 1
+                else:
+                    return count
+            return count
+
+        plain = PipeDreamFlush(num_stages=2 * m, num_meshes=m, num_batch=n)
+        overlap = OverlapFriendlyPipeDreamSchedule(num_stages=2 * m,
+                                                   num_meshes=m, num_batch=n)
+        assert warmup_len(plain) == m  # m-1 warmup + 1 steady fwd
+        assert warmup_len(overlap) == 2 * m  # 2m-1 warmup + 1 steady fwd
+
     def test_inference(self):
         s = InferenceSchedule(num_stages=3, num_meshes=3, num_batch=4)
         _check_complete(s, 3, 4, has_backward=False)
 
     def test_factory(self):
-        for name in ("gpipe", "1f1b", "inference"):
+        for name in ("gpipe", "1f1b", "1f1b_overlap_friendly", "inference"):
             s = create_pipeline_schedule(name, num_stages=4, num_meshes=2,
                                          num_batch=2)
             assert s.num_clock > 0

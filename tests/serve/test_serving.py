@@ -245,3 +245,68 @@ class TestBeamSearch:
 
         # the beam result's sequence log-prob must be >= greedy's
         assert seq_logprob(beam) >= seq_logprob(greedy) - 1e-4
+
+
+class TestHFWrapper:
+    """HF-GenerationMixin-shaped front (ref wrapper.py:501)."""
+
+    def test_generate_hf_interface(self):
+        from alpa_tpu.serve import WrappedInferenceModel
+        gen = _tiny_generator()
+        m = WrappedInferenceModel(gen)
+        ids = np.array([[1, 2, 3, 4]])
+        out = m.generate(input_ids=ids, max_new_tokens=5)
+        assert out.shape == (1, 9)
+        assert (out[:, :4] == ids).all()
+        # max_length alias
+        out2 = m.generate(input_ids=ids, max_length=9)
+        np.testing.assert_array_equal(out, out2)
+        # beam path
+        beam = m.generate(input_ids=ids, num_beams=2, max_new_tokens=5)
+        assert beam.shape == (1, 9)
+        # beam + attention_mask: trailing pads are trimmed, so the result
+        # matches beaming the unpadded prompt
+        padded = np.array([[1, 2, 3, 4, 0, 0]])
+        mask = np.array([[1, 1, 1, 1, 0, 0]])
+        beam2 = m.generate(input_ids=padded, attention_mask=mask,
+                           num_beams=2, max_new_tokens=5)
+        np.testing.assert_array_equal(beam, beam2)
+        # forward returns logits
+        logits = m(ids)
+        assert logits.shape == (1, 4, gen.config.vocab_size)
+
+    def test_generate_attention_mask_lengths(self):
+        from alpa_tpu.serve import WrappedInferenceModel
+        gen = _tiny_generator()
+        m = WrappedInferenceModel(gen)
+        ids = np.array([[5, 6, 7, 0], [8, 9, 0, 0]])
+        mask = np.array([[1, 1, 1, 0], [1, 1, 0, 0]])
+        out = m.generate(input_ids=ids, attention_mask=mask,
+                         max_new_tokens=3, pad_token_id=0)
+        assert out.shape[0] == 2
+        # row 0 continues after its 3 real tokens, row 1 after 2
+        assert (out[0, :3] == [5, 6, 7]).all()
+        assert (out[1, :2] == [8, 9]).all()
+        # separate single generations match the batched masked ones
+        solo0 = m.generate(input_ids=np.array([[5, 6, 7]]),
+                           max_new_tokens=3)
+        np.testing.assert_array_equal(out[0, :6], solo0[0])
+
+    def test_hf_checkpoint_loading(self):
+        torch = pytest.importorskip("torch")
+        transformers = pytest.importorskip("transformers")
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        from alpa_tpu.serve import get_hf_model
+        hf_config = GPT2Config(vocab_size=128, n_positions=32, n_embd=48,
+                               n_layer=2, n_head=4, attn_pdrop=0.0,
+                               resid_pdrop=0.0, embd_pdrop=0.0)
+        hf_model = GPT2LMHeadModel(hf_config).eval()
+        m = get_hf_model(hf_model)
+        ids = np.random.RandomState(0).randint(0, 128, (1, 8))
+        out = m.generate(input_ids=torch.tensor(ids), max_new_tokens=4)
+        assert out.shape == (1, 12)
+        # greedy continuation matches HF's own generate
+        want = hf_model.generate(torch.tensor(ids), max_new_tokens=4,
+                                 do_sample=False).numpy()
+        np.testing.assert_array_equal(out, want)

@@ -126,5 +126,53 @@ class TestTrainConverted:
         assert losses[-1] < losses[0] * 0.8, losses
 
 
+class TestOptimAndTrainer:
+
+    def test_adam_matches_torch_adam(self):
+        """Functional adam == torch.optim.Adam trajectories (the reference
+        ships a placeholder here, ref alpa/torch/optim/adam.py:24)."""
+        from alpa_tpu.torch_frontend.optim import adam
+
+        m = torch.nn.Linear(4, 3)
+        x = torch.randn(8, 4)
+        y = torch.randn(8, 3)
+        opt = torch.optim.Adam(m.parameters(), lr=1e-2)
+        fn, params = functionalize(m)
+        optim_func, _init, state = adam(lr=1e-2)(params)
+
+        xj, yj = jnp.asarray(x.numpy()), jnp.asarray(y.numpy())
+        for _ in range(5):
+            # torch side
+            opt.zero_grad()
+            loss = ((m(x) - y)**2).mean()
+            loss.backward()
+            opt.step()
+            # jax side
+            grads = jax.grad(
+                lambda p: ((fn(p, xj) - yj)**2).mean())(params)
+            params, state = optim_func(params, state, grads)
+        with torch.no_grad():
+            want = m(x).numpy()
+        got = np.asarray(fn(params, xj))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_trainer_loop(self):
+        """TorchTrainer: torch module in, parallel train steps out
+        (ref alpa/torch/trainer.py train_torch_module)."""
+        from alpa_tpu.torch_frontend import TorchTrainer
+        from alpa_tpu.torch_frontend.optim import sgd
+
+        m = torch.nn.Sequential(torch.nn.Linear(16, 32), torch.nn.Tanh(),
+                                torch.nn.Linear(32, 1))
+        trainer = TorchTrainer(
+            m, loss_func=lambda out, tgt: ((out - tgt)**2).mean(),
+            optim_gen=sgd(lr=5e-2, momentum=0.9),
+            method=alpa_tpu.DataParallel())
+        x = torch.randn(64, 16)
+        y = torch.randn(64, 1)
+        losses = trainer.fit([(x, y)] * 10)
+        assert losses[-1] < losses[0] * 0.8, losses
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
