@@ -21,6 +21,7 @@ import dataclasses
 import json
 import logging
 import time
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -252,12 +253,35 @@ def profile_one_mesh(physical_mesh,
                       if physical_mesh.flat_devices[0].platform
                       in ("tpu", "axon") else jnp.float32)
 
-    # dots: a ladder of sizes so MXU efficiency vs size is captured
+    # dots: a ladder of sizes so MXU efficiency vs size is captured.
+    # Timing protocol (ref _compile_profiling_executable_while_loop:274):
+    # a dependent-chain fori_loop of k matmuls inside ONE program ending
+    # in a scalar D2H readback, at two iteration counts — the difference
+    # cancels both the fixed dispatch/readback cost (a ~70 ms round trip
+    # on remote-attached chips, where block_until_ready is NOT a true
+    # fence) and the loop setup.
     for n in dot_ns:
-        a = jnp.asarray(np.random.RandomState(0).randn(n, n), dtype)
-        f = jax.jit(lambda a: a @ a)
-        sec = benchmark_func(lambda: jax.block_until_ready(f(a)),
-                             warmup=2, repeat=2, number=5).min()
+        # iteration counts scale inversely with op size so the measured
+        # chain rises well above timing noise even for tiny matmuls
+        k1 = 8
+        k2 = max(40, int(2e11 / (2.0 * n**3)))
+        a = jnp.asarray(np.random.RandomState(0).randn(n, n) * 0.01,
+                        dtype)
+
+        def chain(a, iters):
+            def body(_, x):
+                y = x @ a
+                # keep magnitudes bounded without leaving the MXU path
+                return y * jnp.asarray(0.5, dtype)
+            out = jax.lax.fori_loop(0, iters, body, a)
+            return out.astype(jnp.float32).sum()
+
+        t = {}
+        for k in (k1, k2):
+            f = jax.jit(partial(chain, iters=k))
+            t[k] = benchmark_func(lambda f=f: float(f(a)),
+                                  warmup=2, repeat=2, number=3).min()
+        sec = max((t[k2] - t[k1]) / (k2 - k1), 1e-9)
         result.record("dot", (np.dtype(dtype).name,), 2.0 * n**3, sec)
 
     if n_dev > 1:
