@@ -427,3 +427,100 @@ def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
                     v.aval.dtype.itemsize
     n = max(logical_mesh.num_devices, 1)
     return param_bytes / n + act_bytes * num_in_flight
+
+
+########################################
+# measured stage profiling (opt-in)
+########################################
+
+
+def profile_stage_cost(stage_comps, num_devices: int, as_option,
+                       niter: int = 3) -> float:
+    """Compile + time one candidate stage on the first ``num_devices``
+    available devices (ref ProfileWorker._profile_impl,
+    stage_profiling.py:321: real submesh, dummy inputs).
+
+    The candidate runs under the SAME intra-op planner the final compile
+    uses, so the measured time includes its collectives.  Ends in a
+    scalar readback (true fence on remote-attached chips).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax._src.core import jaxpr_as_fun
+
+    from alpa_tpu.pipeline_parallel.computation import merge_computations
+
+    comp = (merge_computations(list(stage_comps), "profile_probe")
+            if len(stage_comps) > 1 else stage_comps[0])
+    closed = comp.closed_jaxpr()
+    fun = jaxpr_as_fun(closed)
+    avals = [v.aval for v in comp.invars]
+
+    devices = jax.devices()[:num_devices]
+    if len(devices) < num_devices:
+        raise ValueError(
+            f"cannot profile a {num_devices}-device candidate on "
+            f"{len(jax.devices())} devices")
+
+    in_shardings = None
+    if num_devices > 1 and as_option is not None and \
+            getattr(as_option, "enable_auto_sharding", True):
+        try:
+            from alpa_tpu.device_mesh import LocalPhysicalDeviceMesh
+            from alpa_tpu.shard_parallel.solver import plan_auto_sharding
+            pm = LocalPhysicalDeviceMesh(devices)
+            _mesh, in_shardings, cfn, _ = plan_auto_sharding(
+                fun, avals, [""] * len(avals), [], pm, as_option)
+            if cfn is not None:
+                fun = cfn
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug("profile candidate planning failed: %s", e)
+            in_shardings = None
+
+    def wrapped(*args):
+        outs = fun(*args)
+        acc = jnp.zeros((), jnp.float32)
+        for o in outs:
+            if hasattr(o, "astype"):
+                acc = acc + o.astype(jnp.float32).sum()
+        return acc
+
+    jitted = (jax.jit(wrapped, in_shardings=tuple(in_shardings))
+              if in_shardings is not None else jax.jit(wrapped))
+    args = [jnp.zeros(a.shape, a.dtype) if hasattr(a, "shape") else 0
+            for a in avals]
+    float(jitted(*args))  # compile + warmup
+    tic = time.perf_counter()
+    for _ in range(niter):
+        val = jitted(*args)
+    float(val)
+    return (time.perf_counter() - tic) / niter
+
+
+def refine_costs_measured(costs, layer_comps, submesh_sizes, as_option,
+                          limit: int = 16):
+    """Replace the most promising cost-model entries with measured times
+    (the TPU adaptation of ref get_compute_cost's full profile sweep,
+    SURVEY.md §7 hard part 2: cost model as default, real profiling as
+    refinement).  Candidates are shortlisted by modeled cost; at most
+    ``limit`` are compiled + timed in this process.  Returns the number
+    of entries refined.
+    """
+    import jax
+
+    L, _, M = costs.shape
+    n_avail = len(jax.devices())
+    cands = [(costs[i, j, m], i, j, m)
+             for i in range(L) for j in range(i, L) for m in range(M)
+             if np.isfinite(costs[i, j, m]) and submesh_sizes[m] <= n_avail]
+    cands.sort()
+    refined = 0
+    for _cost, i, j, m in cands[:limit]:
+        try:
+            costs[i, j, m] = profile_stage_cost(
+                layer_comps[i:j + 1], int(submesh_sizes[m]), as_option)
+            refined += 1
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug("measured profile (%d,%d,%d) failed: %s",
+                         i, j, m, e)
+    return refined

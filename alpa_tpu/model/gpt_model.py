@@ -52,8 +52,17 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     # rematerialize each transformer block (training memory <-> flops)
     remat_blocks: bool = False
+    # remat policy: None = save nothing (max memory savings, full
+    # recompute); "dots" = save matmul outputs (bounded memory, skips
+    # recomputing the MXU-heavy ops — usually the best throughput point)
+    remat_policy: Optional[str] = None
     # decoder (causal) vs encoder (bidirectional, BERT-style)
     causal: bool = True
+    # MLP activation: "gelu" (GPT-2) | "relu" (OPT)
+    activation: str = "gelu"
+    # learned-positional-table offset (OPT reserves the first 2 rows,
+    # ref examples/llm_serving/model/opt_model.py position handling)
+    pos_offset: int = 0
 
 
 # The reference benchmark ladder: name -> (hidden, layers, heads)
@@ -191,7 +200,8 @@ class MLPBlock(nn.Module):
         cfg = self.config
         h = cfg.hidden_size
         x = nn.Dense(cfg.mlp_ratio * h, dtype=cfg.dtype, name="fc_in")(x)
-        x = nn.gelu(x, approximate=True)
+        x = (nn.relu(x) if cfg.activation == "relu" else
+             nn.gelu(x, approximate=True))
         x = nn.Dense(h, dtype=cfg.dtype, name="fc_out")(x)
         return x
 
@@ -231,12 +241,21 @@ class GPTModel(nn.Module):
         tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                            dtype=cfg.dtype, name="wte")
         x = tok_emb(input_ids)
-        x = x + nn.Embed(cfg.seq_len, cfg.hidden_size, dtype=cfg.dtype,
-                         name="wpe")(position_ids)
+        x = x + nn.Embed(cfg.seq_len + cfg.pos_offset, cfg.hidden_size,
+                         dtype=cfg.dtype,
+                         name="wpe")(position_ids + cfg.pos_offset)
         block_cls = TransformerBlock
         if cfg.remat_blocks and kv_caches is None:
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies. \
+                    dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy is not None:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}")
             block_cls = nn.remat(TransformerBlock,
-                                 static_argnums=(2, 3))
+                                 static_argnums=(2, 3),
+                                 policy=policy)
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
             if (cfg.pipeline_boundary_every and i > 0 and

@@ -79,6 +79,110 @@ def config_from_hf_gpt2(hf_config) -> GPTConfig:
                      tie_embeddings=True)
 
 
+def convert_opt_state_dict(state_dict: Dict[str, Any],
+                           config: GPTConfig) -> Dict:
+    """HF OPTForCausalLM state dict -> GPTModel params (ref
+    examples/llm_serving/model/opt_model.py:865 weight mapping).
+
+    OPT uses separate q/k/v nn.Linear layers with (out, in) weights —
+    transposed and fused into our (in, 3*out) qkv kernel — a ReLU MLP,
+    and a learned positional table whose first ``pos_offset``(=2) rows
+    are reserved.
+    """
+    sd = {k: _np(v) for k, v in state_dict.items()}
+
+    def get(key):
+        out = sd.get("model.decoder." + key, sd.get("decoder." + key))
+        if out is None:
+            raise KeyError(
+                f"state dict missing decoder key {key!r} — not an "
+                "OPT-family checkpoint?")
+        return out
+
+    def lin(prefix):
+        return {"kernel": get(prefix + ".weight").T,
+                "bias": get(prefix + ".bias")}
+
+    params = {
+        "wte": {"embedding": get("embed_tokens.weight")},
+        "wpe": {"embedding":
+                get("embed_positions.weight")
+                [:config.seq_len + config.pos_offset]},
+        "ln_f": {"scale": get("final_layer_norm.weight"),
+                 "bias": get("final_layer_norm.bias")},
+    }
+    for i in range(config.num_layers):
+        p = f"layers.{i}."
+        qkv_kernel = np.concatenate(
+            [get(p + f"self_attn.{x}_proj.weight").T for x in "qkv"],
+            axis=1)
+        qkv_bias = np.concatenate(
+            [get(p + f"self_attn.{x}_proj.bias") for x in "qkv"])
+        params[f"h{i}"] = {
+            "ln1": {"scale": get(p + "self_attn_layer_norm.weight"),
+                    "bias": get(p + "self_attn_layer_norm.bias")},
+            "ln2": {"scale": get(p + "final_layer_norm.weight"),
+                    "bias": get(p + "final_layer_norm.bias")},
+            "attn": {
+                "qkv": {"kernel": qkv_kernel, "bias": qkv_bias},
+                "out": lin(p + "self_attn.out_proj"),
+            },
+            "mlp": {
+                "fc_in": lin(p + "fc1"),
+                "fc_out": lin(p + "fc2"),
+            },
+        }
+    return {"params": params}
+
+
+def config_from_hf_opt(hf_config) -> GPTConfig:
+    assert getattr(hf_config, "do_layer_norm_before", True), (
+        "OPT-350m's post-norm layout is not supported; use a pre-norm "
+        "OPT size (125m, 1.3b, 2.7b, ...)")
+    assert hf_config.ffn_dim % hf_config.hidden_size == 0
+    return GPTConfig(vocab_size=hf_config.vocab_size,
+                     hidden_size=hf_config.hidden_size,
+                     num_layers=hf_config.num_hidden_layers,
+                     num_heads=hf_config.num_attention_heads,
+                     seq_len=hf_config.max_position_embeddings,
+                     mlp_ratio=hf_config.ffn_dim // hf_config.hidden_size,
+                     activation=hf_config.activation_function,
+                     pos_offset=2,
+                     tie_embeddings=True)
+
+
+def load_opt(model_name_or_model,
+             dtype=jnp.float32,
+             shardings: Optional[Any] = None):
+    """Build (GPTModel, params, config) from a HF OPT model or name
+    (ref opt_model.py:865,956 — ``shardings`` places each leaf directly
+    with its target sharding, the distributed-loading path)."""
+    from alpa_tpu.model.gpt_model import GPTModel
+
+    if isinstance(model_name_or_model, str):
+        from transformers import OPTForCausalLM
+        hf_model = OPTForCausalLM.from_pretrained(model_name_or_model)
+    else:
+        hf_model = model_name_or_model
+    config = config_from_hf_opt(hf_model.config)
+    params = convert_opt_state_dict(hf_model.state_dict(), config)
+    params = _place(params, dtype, shardings)
+    return GPTModel(config), params, config
+
+
+def _place(params, dtype, shardings):
+    if shardings is not None:
+        # leaves stay numpy until device_put with the TARGET sharding —
+        # no full per-device replica ever materializes.  is_leaf lets
+        # None entries in the shardings tree mean "replicate this leaf".
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(np.asarray(x, dtype), s)
+            if s is not None else jnp.asarray(x, dtype),
+            params, shardings,
+            is_leaf=lambda t: t is None)
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), params)
+
+
 def load_gpt2(model_name_or_model,
               dtype=jnp.float32,
               shardings: Optional[Any] = None):
@@ -97,16 +201,5 @@ def load_gpt2(model_name_or_model,
         hf_model = model_name_or_model
     config = config_from_hf_gpt2(hf_model.config)
     params = convert_gpt2_state_dict(hf_model.state_dict(), config)
-    if shardings is not None:
-        # leaves stay numpy until device_put with the TARGET sharding —
-        # no full per-device replica ever materializes.  is_leaf lets None
-        # entries in the shardings tree mean "replicate this leaf".
-        params = jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(np.asarray(x, dtype), s)
-            if s is not None else jnp.asarray(x, dtype),
-            params, shardings,
-            is_leaf=lambda t: t is None)
-    else:
-        params = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, dtype), params)
+    params = _place(params, dtype, shardings)
     return GPTModel(config), params, config

@@ -53,6 +53,12 @@ class AutoStageOption(StageOption):
     # large search spaces (slower, more accurate).
     use_hlo_cost_model: bool = True
     profiling_database_filename: Optional[str] = None
+    # "cost_model" (default) | "measured": compile + time the shortlisted
+    # candidate stages on real devices (ref ProfileWorker path; SURVEY §7
+    # hard part 2 — cost model default, real profiling opt-in)
+    profiling_mode: str = "cost_model"
+    # max candidates compiled+timed in "measured" mode
+    measured_candidates_limit: int = 16
     # Per-device memory budget in bytes (None = unconstrained).
     memory_budget_per_device: Optional[float] = None
 

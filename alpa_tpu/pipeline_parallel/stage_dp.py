@@ -231,6 +231,13 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                         comps, logical, num_in_flight=min(
                             num_micro_batches, 4))
 
+    if getattr(stage_option, "profiling_mode", "cost_model") == "measured":
+        from alpa_tpu.mesh_profiling import refine_costs_measured
+        n = refine_costs_measured(
+            costs, layer_comps, sizes, auto_sharding_option,
+            limit=getattr(stage_option, "measured_candidates_limit", 16))
+        logger.info("measured stage profiling refined %d candidates", n)
+
     # stage_imbalance_tolerance: cap the DP's max-stage-cost threshold at
     # tolerance * (best perfectly-balanced stage cost estimate).
     tol = float(getattr(stage_option, "stage_imbalance_tolerance", np.inf))

@@ -118,10 +118,15 @@ def get_hf_model(model_name_or_model,
     device)."""
     import jax.numpy as jnp
 
-    from alpa_tpu.model.weight_loading import load_gpt2
+    from alpa_tpu.model.weight_loading import load_gpt2, load_opt
 
-    model, params, config = load_gpt2(model_name_or_model,
-                                      dtype=dtype or jnp.float32,
-                                      shardings=shardings)
+    loader = load_gpt2
+    name = (model_name_or_model if isinstance(model_name_or_model, str)
+            else type(model_name_or_model).__name__)
+    if "opt" in name.lower():
+        loader = load_opt
+    model, params, config = loader(model_name_or_model,
+                                   dtype=dtype or jnp.float32,
+                                   shardings=shardings)
     gen = Generator(model, params, config, prompt_buckets=prompt_buckets)
     return WrappedInferenceModel(gen, eos_token_id=eos_token_id)

@@ -18,12 +18,12 @@ from alpa_tpu.util import compute_gpt_tflops
 
 
 def run_one(attention_impl, remat, chunked, batch_size=8,
-            hidden=768, layers=12):
+            hidden=768, layers=12, seq_len=1024, remat_policy=None):
     config = GPTConfig(hidden_size=hidden, num_layers=layers,
                       num_heads=hidden // 64,
-                      seq_len=1024, vocab_size=51200,
+                      seq_len=seq_len, vocab_size=51200,
                       dtype=jnp.bfloat16, attention_impl=attention_impl,
-                      remat_blocks=remat)
+                      remat_blocks=remat, remat_policy=remat_policy)
     model = GPTModel(config)
     rng = jax.random.PRNGKey(0)
     input_ids = jax.random.randint(rng, (batch_size, config.seq_len), 0,
@@ -65,8 +65,9 @@ def run_one(attention_impl, remat, chunked, batch_size=8,
                                 config.num_layers, config.hidden_size,
                                 config.vocab_size, 1, latency)
     print(json.dumps({"attn": attention_impl, "remat": remat,
+                      "policy": remat_policy,
                       "chunked_ce": chunked, "batch": batch_size,
-                      "hidden": hidden, "layers": layers,
+                      "hidden": hidden, "layers": layers, "seq": seq_len,
                       "latency_s": round(latency, 5),
                       "tflops": round(tflops, 2)}), flush=True)
     del state, params
@@ -100,6 +101,20 @@ SWEEPS = {
         ("reference", True, True, 2048, 24),
         ("reference", True, True, 2560, 16),
     ],
+    # remat-policy rung (2026-07-29): "dots" saves matmul outputs.
+    # RESULT: h2048 l16 bs8 with "dots" WEDGED the relay (est 14.4 GB:
+    # 4.8 GB saved dots + 9.6 GB params/adam > safe envelope) — no case
+    # completed.  Keep "dots" for smaller models / bs<=4 only; the bench
+    # default stays full-block remat.
+    "policy": [
+        dict(attention_impl="reference", remat=True, chunked=False,
+             hidden=2048, layers=16, remat_policy="dots", batch_size=4),
+        dict(attention_impl="reference", remat=False, chunked=False,
+             hidden=2048, layers=16, batch_size=4),
+        dict(attention_impl="reference", remat=True, chunked=False,
+             hidden=2048, layers=16, remat_policy="dots", batch_size=4,
+             seq_len=2048),
+    ],
 }
 
 
@@ -107,13 +122,15 @@ def main():
     import sys
     alpa_tpu.init(cluster="local")
     configs = SWEEPS[sys.argv[1] if len(sys.argv) > 1 else "impl"]
-    for attn, remat, chunked, hidden, layers in configs:
+    for case in configs:
+        kw = dict(case) if isinstance(case, dict) else dict(
+            zip(("attention_impl", "remat", "chunked", "hidden", "layers"),
+                case))
         try:
-            run_one(attn, remat, chunked, hidden=hidden, layers=layers)
+            run_one(kw.pop("attention_impl"), kw.pop("remat"),
+                    kw.pop("chunked"), **kw)
         except Exception as e:  # pylint: disable=broad-except
-            print(json.dumps({"attn": attn, "remat": remat,
-                              "chunked_ce": chunked, "hidden": hidden,
-                              "layers": layers,
+            print(json.dumps({"case": repr(case),
                               "error": repr(e)[:200]}), flush=True)
 
 

@@ -29,6 +29,52 @@ class TestGPT2Loading:
         got = np.asarray(model.apply(params, jnp.asarray(ids)))
         np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
 
+    def test_opt_logits_match_transformers(self):
+        """OPT family (the reference's flagship serving model, ref
+        examples/llm_serving/model/opt_model.py)."""
+        from transformers import OPTConfig, OPTForCausalLM
+
+        from alpa_tpu.model.weight_loading import load_opt
+
+        hf_config = OPTConfig(vocab_size=128, hidden_size=48,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              ffn_dim=192, max_position_embeddings=32,
+                              do_layer_norm_before=True,
+                              activation_function="relu", dropout=0.0,
+                              attention_dropout=0.0)
+        hf_model = OPTForCausalLM(hf_config).eval()
+        model, params, config = load_opt(hf_model)
+        assert config.activation == "relu" and config.pos_offset == 2
+
+        ids = np.random.RandomState(0).randint(0, 128, (2, 16))
+        with torch.no_grad():
+            want = hf_model(torch.tensor(ids)).logits.numpy()
+        got = np.asarray(model.apply(params, jnp.asarray(ids)))
+        np.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+    def test_opt_generate_matches_transformers(self):
+        from transformers import OPTConfig, OPTForCausalLM
+
+        from alpa_tpu.model.weight_loading import load_opt
+        from alpa_tpu.serve import Generator
+
+        hf_config = OPTConfig(vocab_size=128, hidden_size=48,
+                              num_hidden_layers=2, num_attention_heads=4,
+                              ffn_dim=192, max_position_embeddings=32,
+                              do_layer_norm_before=True,
+                              activation_function="relu", dropout=0.0,
+                              attention_dropout=0.0)
+        hf_model = OPTForCausalLM(hf_config).eval()
+        model, params, config = load_opt(hf_model)
+        from alpa_tpu.serve import GenerationConfig
+        gen = Generator(model, params, config)
+        ids = np.random.RandomState(1).randint(4, 128, (1, 8))
+        out = gen.generate(ids, GenerationConfig(max_new_tokens=16))
+        want = hf_model.generate(torch.tensor(ids), max_new_tokens=16,
+                                 do_sample=False).numpy()
+        np.testing.assert_array_equal(np.asarray(out)[:, :want.shape[1]],
+                                      want)
+
     def test_sharded_loading(self):
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

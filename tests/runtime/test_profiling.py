@@ -78,3 +78,56 @@ class TestProfilingDatabase:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestMeasuredStageProfiling:
+    """Opt-in compile+time of candidate stages (ref ProfileWorker,
+    stage_profiling.py:321)."""
+
+    def test_profile_stage_cost_runs_candidate(self):
+        import jax
+        import jax.numpy as jnp
+
+        from alpa_tpu.mesh_profiling import profile_stage_cost
+        from alpa_tpu.pipeline_parallel.computation import (
+            JaxPipelineComputation)
+        from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+
+        def f(x, w):
+            return jnp.tanh(x @ w) @ w
+
+        closed = jax.make_jaxpr(f)(jnp.zeros((64, 64)), jnp.zeros((64, 64)))
+        comp = JaxPipelineComputation(
+            "probe", list(closed.jaxpr.invars), list(closed.jaxpr.outvars),
+            list(closed.jaxpr.eqns))
+        t1 = profile_stage_cost([comp], 1, AutoShardingOption())
+        t8 = profile_stage_cost([comp], 8, AutoShardingOption())
+        assert t1 > 0 and t8 > 0
+
+    def test_measured_mode_refines_and_still_correct(self):
+        """AutoStageOption(profiling_mode='measured') end-to-end: the DP
+        runs on (partially) measured costs; numerics stay correct."""
+        import alpa_tpu
+        from alpa_tpu.pipeline_parallel.layer_construction import (
+            AutoLayerOption)
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            AutoStageOption)
+        from alpa_tpu.testing import (assert_allclose,
+                                      create_mlp_train_state_and_batch,
+                                      get_mlp_train_step)
+
+        alpa_tpu.init(cluster="local")
+        state_p, batch = create_mlp_train_state_and_batch(
+            batch_size=32, num_layers=4, manual_pipeline_layer=False)
+        state_s, _ = create_mlp_train_state_and_batch(
+            batch_size=32, num_layers=4, manual_pipeline_layer=False)
+        method = alpa_tpu.PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=2),
+            stage_option=AutoStageOption(profiling_mode="measured",
+                                         measured_candidates_limit=6))
+        pstep = get_mlp_train_step(method, use_value_and_grad=True)
+        serial = get_mlp_train_step(None)
+        state_p, loss_p = pstep(state_p, batch)
+        state_s, loss_s = serial(state_s, batch)
+        assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
