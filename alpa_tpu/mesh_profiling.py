@@ -410,23 +410,35 @@ def estimate_stage_cost(stage_comps,
     return compute_cost + comm_cost
 
 
-def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
-                          num_in_flight: int = 1) -> float:
-    """Rough per-device bytes: params/devices + activations in flight."""
-    comps = stage_comps
+def estimate_stage_memory_split(stage_comps,
+                                logical_mesh: LogicalDeviceMesh
+                                ) -> Tuple[float, float]:
+    """(per-device param bytes, per-microbatch activation bytes).
+
+    Split so the stage DP can apply the position-aware 1F1B in-flight
+    factor (ref max_n_succ_stages, stage_profiling.py:756): total =
+    param + min(stages_from_end, B) * act.
+    """
     param_bytes = 0.0
     act_bytes = 0.0
-    for c in comps:
+    for c in stage_comps:
         for v in c.invars:
             if hasattr(v.aval, "shape"):
-                b = float(np.prod(v.aval.shape) or 1) * v.aval.dtype.itemsize
-                param_bytes += b
+                param_bytes += float(np.prod(v.aval.shape) or 1) * \
+                    v.aval.dtype.itemsize
         for v in c.outvars:
             if hasattr(v.aval, "shape"):
                 act_bytes += float(np.prod(v.aval.shape) or 1) * \
                     v.aval.dtype.itemsize
     n = max(logical_mesh.num_devices, 1)
-    return param_bytes / n + act_bytes * num_in_flight
+    return param_bytes / n, act_bytes
+
+
+def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
+                          num_in_flight: int = 1) -> float:
+    """Rough per-device bytes: params/devices + activations in flight."""
+    p, a = estimate_stage_memory_split(stage_comps, logical_mesh)
+    return p + a * num_in_flight
 
 
 ########################################

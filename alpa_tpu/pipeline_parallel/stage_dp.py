@@ -56,6 +56,7 @@ def _load_native():
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+                np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
                 ctypes.c_double,
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
@@ -70,28 +71,37 @@ def stage_dp_solve(costs: np.ndarray,
                    submesh_sizes: Sequence[int],
                    num_devices: int,
                    num_micro_batches: int,
-                   mem: Optional[np.ndarray] = None,
+                   mem_param: Optional[np.ndarray] = None,
+                   mem_act: Optional[np.ndarray] = None,
                    mem_budget: float = 0.0
                    ) -> Optional[List[Tuple[int, int, int]]]:
     """Solve the stage-construction DP.
 
     costs: (L, L, M) float64; costs[i, j, m] = cost of layers i..j (incl.)
-    on submesh m (inf = infeasible).  Returns list of
-    (start_layer, end_layer_exclusive, submesh_idx) or None if infeasible.
+    on submesh m (inf = infeasible).  Memory feasibility is position-aware
+    (ref max_n_succ_stages, stage_profiling.py:756): the s-th stage from
+    the pipeline end holds min(s, B) in-flight microbatches under 1F1B, so
+    the check is ``mem_param + min(s, B) * mem_act <= mem_budget``.
+    Returns list of (start_layer, end_layer_exclusive, submesh_idx) or
+    None if infeasible.
     """
     L, _, M = costs.shape
     costs = np.ascontiguousarray(costs, np.float64)
     sizes = np.ascontiguousarray(submesh_sizes, np.int64)
-    if mem is None:
-        mem = np.zeros_like(costs)
-    mem = np.ascontiguousarray(mem, np.float64)
+    if mem_param is None:
+        mem_param = np.zeros_like(costs)
+    if mem_act is None:
+        mem_act = np.zeros_like(costs)
+    mem_param = np.ascontiguousarray(mem_param, np.float64)
+    mem_act = np.ascontiguousarray(mem_act, np.float64)
 
     lib = _load_native()
     if lib is not None:
         starts = np.zeros(L, np.int32)
         meshes = np.zeros(L, np.int32)
         S = lib.stage_dp_solve(L, M, num_devices, num_micro_batches, costs,
-                               sizes, mem, mem_budget, starts, meshes)
+                               sizes, mem_param, mem_act, mem_budget,
+                               starts, meshes)
         if S < 0:
             return None
         out = []
@@ -100,11 +110,13 @@ def stage_dp_solve(costs: np.ndarray,
             out.append((int(starts[t]), int(end), int(meshes[t])))
         return out
     return _stage_dp_python(costs, sizes, num_devices, num_micro_batches,
-                            mem, mem_budget)
+                            mem_param, mem_act, mem_budget)
 
 
-def _stage_dp_python(C, sizes, D, B, mem, mem_budget):
-    """Pure-Python fallback, same algorithm as csrc/stage_dp.cc."""
+def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget):
+    """Pure-Python fallback, same algorithm as csrc/stage_dp.cc
+    (f[l][d][s] with the suffix-stage-count dimension for position-aware
+    1F1B memory feasibility)."""
     L, _, M = C.shape
     INF = float("inf")
     finite = C[np.isfinite(C)]
@@ -116,45 +128,51 @@ def _stage_dp_python(C, sizes, D, B, mem, mem_budget):
     for t_max in candidates:
         if best_part is not None and (B - 1) * t_max >= best_obj:
             break
-        f = np.full((L + 1, D + 1), INF)
-        cj = np.full((L + 1, D + 1), -1, np.int32)
-        cm = np.full((L + 1, D + 1), -1, np.int32)
-        f[L][0] = 0.0
+        f = np.full((L + 1, D + 1, L + 1), INF)
+        cj = np.full((L + 1, D + 1, L + 1), -1, np.int32)
+        cm = np.full((L + 1, D + 1, L + 1), -1, np.int32)
+        f[L][0][0] = 0.0
         for l in range(L - 1, -1, -1):
             for d in range(1, D + 1):
-                for j in range(l, L):
-                    for m in range(M):
-                        n = int(sizes[m])
-                        if n > d:
-                            continue
-                        c = C[l, j, m]
-                        if not np.isfinite(c) or c > t_max:
-                            continue
-                        if mem_budget > 0 and mem[l, j, m] > mem_budget:
-                            continue
-                        rest = f[j + 1][d - n]
-                        if rest == INF:
-                            continue
-                        if c + rest < f[l][d]:
-                            f[l][d] = c + rest
-                            cj[l][d] = j
-                            cm[l][d] = m
-        if f[0][D] == INF:
+                for s in range(1, L - l + 1):
+                    inflight = min(s, max(B, 1))
+                    for j in range(l, L):
+                        for m in range(M):
+                            n = int(sizes[m])
+                            if n > d:
+                                continue
+                            c = C[l, j, m]
+                            if not np.isfinite(c) or c > t_max:
+                                continue
+                            if mem_budget > 0 and \
+                                    mem_param[l, j, m] + inflight * \
+                                    mem_act[l, j, m] > mem_budget:
+                                continue
+                            rest = f[j + 1][d - n][s - 1]
+                            if rest == INF:
+                                continue
+                            if c + rest < f[l][d][s]:
+                                f[l][d][s] = c + rest
+                                cj[l][d][s] = j
+                                cm[l][d][s] = m
+        s_best = int(np.argmin(f[0][D]))
+        if f[0][D][s_best] == INF:
             continue
-        obj = f[0][D] + (B - 1) * t_max
+        obj = f[0][D][s_best] + (B - 1) * t_max
         if obj < best_obj:
             part = []
-            l, d = 0, D
+            l, d, s = 0, D, s_best
             ok = True
             while l < L:
-                j, m = int(cj[l][d]), int(cm[l][d])
+                j, m = int(cj[l][d][s]), int(cm[l][d][s])
                 if j < 0:
                     ok = False
                     break
                 part.append((l, j + 1, m))
                 d -= int(sizes[m])
                 l = j + 1
-            if ok and d == 0:
+                s -= 1
+            if ok and d == 0 and s == 0:
                 best_obj, best_part = obj, part
     return best_part
 
@@ -170,7 +188,7 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
     (ref cluster_layers_and_slice_mesh auto branch, stage_construction.py:
     571 + SURVEY.md §3.4)."""
     from alpa_tpu.mesh_profiling import (estimate_stage_cost,
-                                         estimate_stage_memory)
+                                         estimate_stage_memory_split)
     from alpa_tpu.pipeline_parallel.stage_construction import (
         get_sliced_virtual_submeshes, get_submesh_choices)
 
@@ -210,7 +228,8 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
         getattr(stage_option, "memory_budget_per_device", None) or 0.0)
 
     costs = np.full((L, L, M), np.inf)
-    mem = np.zeros((L, L, M))
+    mem_param = np.zeros((L, L, M))
+    mem_act = np.zeros((L, L, M))
     for m, (h, d) in enumerate(choices):
         # cost-model-only logical mesh of the candidate submesh shape
         shape = (h * d, 1) if h == 1 else (h, d)
@@ -227,9 +246,8 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                 costs[i, j, m] = estimate_stage_cost(
                     comps, logical, auto_sharding_option, **kwargs)
                 if mem_budget > 0:
-                    mem[i, j, m] = estimate_stage_memory(
-                        comps, logical, num_in_flight=min(
-                            num_micro_batches, 4))
+                    mem_param[i, j, m], mem_act[i, j, m] = \
+                        estimate_stage_memory_split(comps, logical)
 
     if getattr(stage_option, "profiling_mode", "cost_model") == "measured":
         from alpa_tpu.mesh_profiling import refine_costs_measured
@@ -250,8 +268,8 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             cap = tol * balanced / max(1, 1)
             costs = np.where(costs <= cap, costs, np.inf)
 
-    part = stage_dp_solve(costs, sizes, D, num_micro_batches, mem,
-                          mem_budget=mem_budget)
+    part = stage_dp_solve(costs, sizes, D, num_micro_batches, mem_param,
+                          mem_act, mem_budget=mem_budget)
     if part is None:
         raise RuntimeError(
             "auto stage construction found no feasible partition")

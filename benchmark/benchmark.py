@@ -97,8 +97,14 @@ def build_case(case):
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits.astype(jnp.float32), b["y"]).mean()
 
+        # analytic formulas don't cover convs well: count fwd flops off
+        # the traced jaxpr, x3 for fwd+bwd (standard accounting)
+        from alpa_tpu.util import jaxpr_eqn_flops
+        fwd_jaxpr = jax.make_jaxpr(lambda p: model.apply(p, x))(params)
+        fwd_flops = sum(jaxpr_eqn_flops(e) for e in fwd_jaxpr.jaxpr.eqns)
+
         def flops(latency):
-            return float("nan")
+            return 3.0 * fwd_flops / latency / len(jax.devices()) / 1e12
 
         tokens = case.batch_size
     else:

@@ -10,12 +10,22 @@
 // minimize  sum_t cost_t + (B - 1) * max_t cost_t
 // where cost_t = C[i][j][m] for layers i..j on submesh m and B = number of
 // microbatches.  Solved by iterating candidate values of max_t cost_t
-// (t_max) and, for each, a DP over (first uncovered layer, devices left)
-// minimizing the total sum subject to every stage cost <= t_max.
+// (t_max) and, for each, a DP over (first uncovered layer, devices left,
+// stages in the suffix) minimizing the total sum subject to every stage
+// cost <= t_max.
+//
+// Memory feasibility is position-aware (the reference's max_n_succ_stages,
+// stage_profiling.py:756): under 1F1B, the s-th stage from the END holds
+// min(s, B) in-flight microbatches of activations, so the budget check for
+// a candidate stage is
+//   mem_param + min(s, B) * mem_act <= mem_budget
+// which requires the suffix-stage count s as a DP dimension (the
+// reference's f[s][layer][devices] state).
 //
 // Exported C ABI (ctypes):
-//   int stage_dp_solve(L, M, D, B, C[L*L*M], n_devices[M], mem[L*L*M],
-//                      mem_budget, out_starts[L], out_meshes[L]) ->
+//   int stage_dp_solve(L, M, D, B, C[L*L*M], n_devices[M],
+//                      mem_param[L*L*M], mem_act[L*L*M], mem_budget,
+//                      out_starts[L], out_meshes[L]) ->
 //   number of stages (or -1 if infeasible). Stage t covers layers
 //   out_starts[t] .. out_starts[t+1]-1 on submesh out_meshes[t].
 #include <algorithm>
@@ -34,64 +44,84 @@ struct DPResult {
   std::vector<int> meshes;
 };
 
-// DP for a fixed t_max: f[l][d] = min total cost covering layers l..L-1
-// with exactly d devices left. Returns total and the partition.
-bool run_dp(int L, int M, int D, const double* C, const int64_t* ndev,
-            const double* mem, double mem_budget, double t_max,
-            DPResult* out) {
+// DP for a fixed t_max: f[l][d][s] = min total cost covering layers l..L-1
+// with exactly d devices left in exactly s stages.
+bool run_dp(int L, int M, int D, int B, const double* C, const int64_t* ndev,
+            const double* mem_param, const double* mem_act,
+            double mem_budget, double t_max, DPResult* out) {
   const int stride_j = M;
   const int stride_i = L * M;
-  std::vector<double> f((L + 1) * (D + 1), kInf);
-  // choice: encodes (j, m) for backtracking
-  std::vector<int32_t> choice_j((L + 1) * (D + 1), -1);
-  std::vector<int32_t> choice_m((L + 1) * (D + 1), -1);
-  auto idx = [D](int l, int d) { return l * (D + 1) + d; };
-  f[idx(L, 0)] = 0.0;
+  const int S = L + 1;
+  std::vector<double> f(static_cast<size_t>(L + 1) * (D + 1) * S, kInf);
+  std::vector<int32_t> choice_j(f.size(), -1);
+  std::vector<int32_t> choice_m(f.size(), -1);
+  auto idx = [D, S](int l, int d, int s) {
+    return (static_cast<size_t>(l) * (D + 1) + d) * S + s;
+  };
+  f[idx(L, 0, 0)] = 0.0;
 
   for (int l = L - 1; l >= 0; --l) {
     for (int d = 1; d <= D; ++d) {
-      double best = kInf;
-      int bj = -1, bm = -1;
-      for (int j = l; j < L; ++j) {
-        const double* row = C + l * stride_i + j * stride_j;
-        const double* mrow = mem + l * stride_i + j * stride_j;
-        for (int m = 0; m < M; ++m) {
-          const int64_t n = ndev[m];
-          if (n > d) continue;
-          const double c = row[m];
-          if (c > t_max || c >= kInf) continue;
-          if (mem_budget > 0 && mrow[m] > mem_budget) continue;
-          const double rest = f[idx(j + 1, d - static_cast<int>(n))];
-          if (rest >= kInf) continue;
-          const double tot = c + rest;
-          if (tot < best) {
-            best = tot;
-            bj = j;
-            bm = m;
+      for (int s = 1; s <= L - l; ++s) {
+        double best = kInf;
+        int bj = -1, bm = -1;
+        // in-flight microbatches for the stage s-from-the-end under 1F1B
+        const double inflight =
+            static_cast<double>(std::min(s, B > 0 ? B : 1));
+        for (int j = l; j < L; ++j) {
+          const double* row = C + l * stride_i + j * stride_j;
+          const double* prow = mem_param + l * stride_i + j * stride_j;
+          const double* arow = mem_act + l * stride_i + j * stride_j;
+          for (int m = 0; m < M; ++m) {
+            const int64_t n = ndev[m];
+            if (n > d) continue;
+            const double c = row[m];
+            if (c > t_max || c >= kInf) continue;
+            if (mem_budget > 0 &&
+                prow[m] + inflight * arow[m] > mem_budget)
+              continue;
+            const double rest =
+                f[idx(j + 1, d - static_cast<int>(n), s - 1)];
+            if (rest >= kInf) continue;
+            const double tot = c + rest;
+            if (tot < best) {
+              best = tot;
+              bj = j;
+              bm = m;
+            }
           }
         }
+        f[idx(l, d, s)] = best;
+        choice_j[idx(l, d, s)] = bj;
+        choice_m[idx(l, d, s)] = bm;
       }
-      f[idx(l, d)] = best;
-      choice_j[idx(l, d)] = bj;
-      choice_m[idx(l, d)] = bm;
     }
   }
-  if (f[idx(0, D)] >= kInf) return false;
+  double best_total = kInf;
+  int best_s = -1;
+  for (int s = 1; s <= L; ++s) {
+    if (f[idx(0, D, s)] < best_total) {
+      best_total = f[idx(0, D, s)];
+      best_s = s;
+    }
+  }
+  if (best_s < 0) return false;
 
-  out->total = f[idx(0, D)];
+  out->total = best_total;
   out->starts.clear();
   out->meshes.clear();
-  int l = 0, d = D;
+  int l = 0, d = D, s = best_s;
   while (l < L) {
-    const int j = choice_j[idx(l, d)];
-    const int m = choice_m[idx(l, d)];
+    const int j = choice_j[idx(l, d, s)];
+    const int m = choice_m[idx(l, d, s)];
     if (j < 0 || m < 0) return false;
     out->starts.push_back(l);
     out->meshes.push_back(m);
     d -= static_cast<int>(ndev[m]);
     l = j + 1;
+    s -= 1;
   }
-  return d == 0;
+  return d == 0 && s == 0;
 }
 
 }  // namespace
@@ -100,8 +130,9 @@ extern "C" {
 
 int stage_dp_solve(int32_t L, int32_t M, int32_t D, int32_t B,
                    const double* C, const int64_t* n_devices,
-                   const double* mem, double mem_budget,
-                   int32_t* out_starts, int32_t* out_meshes) {
+                   const double* mem_param, const double* mem_act,
+                   double mem_budget, int32_t* out_starts,
+                   int32_t* out_meshes) {
   if (L <= 0 || M <= 0 || D <= 0) return -1;
   // Candidate t_max values: every distinct finite stage cost.
   std::vector<double> candidates;
@@ -122,7 +153,8 @@ int stage_dp_solve(int32_t L, int32_t M, int32_t D, int32_t B,
   DPResult cur;
   for (double t_max : candidates) {
     if (best_obj < kInf && (B - 1) * t_max >= best_obj) break;
-    if (!run_dp(L, M, D, C, n_devices, mem, mem_budget, t_max, &cur))
+    if (!run_dp(L, M, D, B, C, n_devices, mem_param, mem_act, mem_budget,
+                t_max, &cur))
       continue;
     const double obj = cur.total + (B - 1) * t_max;
     if (obj < best_obj) {

@@ -294,8 +294,9 @@ class TestFourStageGPT:
                     s.apply_fn(s.params, b["ids"]).astype(jnp.float32),
                     b["labels"])))
 
-        state_p, loss_p = step_fn(True)(state, batch)
+        # serial first: the parallel step donates the state buffers
         state_s, loss_s = step_fn(False)(state, batch)
+        state_p, loss_p = step_fn(True)(state, batch)
         assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
         assert_allclose(jax.device_get(state_s.params),
                         jax.device_get(state_p.params), 2e-3, 2e-3)
@@ -360,6 +361,33 @@ class TestAutoStage:
         # comm-bound: avoid intra-stage collectives -> many small meshes;
         # compute-bound: parallelize compute -> few large meshes
         assert comm_bound > compute_bound, (comm_bound, compute_bound)
+
+    def test_stage_dp_position_aware_memory(self):
+        """1F1B memory feasibility uses the stage's distance from the
+        pipeline end (ref max_n_succ_stages, stage_profiling.py:756):
+        earlier stages hold more in-flight microbatches, and the C++ and
+        Python solvers agree."""
+        from alpa_tpu.pipeline_parallel.stage_dp import (_stage_dp_python,
+                                                         stage_dp_solve)
+        L, M, D, B = 4, 2, 4, 4
+        C = np.full((L, L, M), np.inf)
+        for i in range(L):
+            for j in range(i, L):
+                C[i, j, 0] = (j - i + 1) * 1.0
+                C[i, j, 1] = (j - i + 1) * 0.6
+        mem_p = np.ones((L, L, M))
+        mem_a = np.full((L, L, M), 2.0)
+
+        for budget, max_stages in ((0.0, 4), (5.0, 2)):
+            native = stage_dp_solve(C, [1, 2], D, B, mem_p, mem_a,
+                                    mem_budget=budget)
+            python = _stage_dp_python(C, np.array([1, 2]), D, B, mem_p,
+                                      mem_a, budget)
+            assert native == python, (budget, native, python)
+            assert native is not None and len(native) <= max_stages
+        # param(1) + 1*act(2) = 3 exceeds 2.9 even for the last stage
+        assert stage_dp_solve(C, [1, 2], D, B, mem_p, mem_a,
+                              mem_budget=2.9) is None
 
     def test_native_dp_solver_loaded(self):
         import shutil
