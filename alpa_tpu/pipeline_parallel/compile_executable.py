@@ -328,7 +328,8 @@ def _compile_inference(fun, virtual_mesh, closed_jaxpr, in_avals,
         cluster_layers_and_slice_mesh(
             num_layers, virtual_mesh, stage_option,
             num_micro_batches=num_micro_batches,
-            layer_comps=computations, auto_sharding_option=as_option)
+            layer_comps=computations, auto_sharding_option=as_option,
+            objective="inference")
     fwd_stages = [
         merge_computations([computations[i] for i in ids], f"stage_{s}_fwd")
         for s, ids in enumerate(fwd_stage_layer_ids)

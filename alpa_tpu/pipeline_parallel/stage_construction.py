@@ -149,7 +149,8 @@ def cluster_layers_and_slice_mesh(
         layer_comps=None,
         donation_mapping=None,
         num_micro_batches: int = 1,
-        auto_sharding_option=None):
+        auto_sharding_option=None,
+        objective: str = "training"):
     """Decide (forward_stage_layer_ids, submeshes, logical shapes, per-stage
     autosharding dicts) (ref cluster_layers_and_slice_mesh:571)."""
     stage_option = stage_option or UniformStageOption()
@@ -168,7 +169,7 @@ def cluster_layers_and_slice_mesh(
         from alpa_tpu.pipeline_parallel.stage_dp import auto_stage_dp
         return auto_stage_dp(num_forward_layers, virtual_mesh, stage_option,
                              layer_flops, layer_comps, num_micro_batches,
-                             auto_sharding_option)
+                             auto_sharding_option, objective=objective)
 
     # Uniform: num_stages = num_hosts (or all devices as equal slices)
     num_stages = (stage_option.num_stages if isinstance(

@@ -183,7 +183,8 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget):
 
 
 def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
-                  layer_comps, num_micro_batches, auto_sharding_option):
+                  layer_comps, num_micro_batches, auto_sharding_option,
+                  objective: str = "training"):
     """Fill the cost tensor with the static cost model and run the DP
     (ref cluster_layers_and_slice_mesh auto branch, stage_construction.py:
     571 + SURVEY.md §3.4)."""
@@ -268,7 +269,12 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             cap = tol * balanced / max(1, 1)
             costs = np.where(costs <= cap, costs, np.inf)
 
-    part = stage_dp_solve(costs, sizes, D, num_micro_batches, mem_param,
+    # objective="inference" (ref inference_dp, stage_construction.py:403):
+    # a forward-only pipeline's throughput is bottlenecked by the slowest
+    # stage, so minimize max stage cost first (sum as tie-break) — the
+    # training objective with B -> large.
+    B_eff = num_micro_batches if objective == "training" else 4096
+    part = stage_dp_solve(costs, sizes, D, B_eff, mem_param,
                           mem_act, mem_budget=mem_budget)
     if part is None:
         raise RuntimeError(

@@ -80,6 +80,32 @@ def build_case(case):
                                       latency)
 
         tokens = case.batch_size * cfg.seq_len
+    elif case.family == "unet":
+        from alpa_tpu.model.unet_2d import UNet2D, UNetConfig
+        cfg = UNetConfig(dtype=dtype, **case.model)
+        model = UNet2D(cfg)
+        res = case.method_kwargs.get("resolution", 32)
+        x = jax.random.normal(rng, (case.batch_size, res, res,
+                                    cfg.in_channels), dtype)
+        t = jax.random.randint(jax.random.PRNGKey(2), (case.batch_size,),
+                               0, 1000)
+        noise = jax.random.normal(jax.random.PRNGKey(3), x.shape, dtype)
+        batch = {"x": x, "t": t, "noise": noise}
+        params = model.init(rng, x, t)
+
+        def loss_of(state, p, b):
+            pred = state.apply_fn(p, b["x"], b["t"])
+            return ((pred.astype(jnp.float32) -
+                     b["noise"].astype(jnp.float32))**2).mean()
+
+        from alpa_tpu.util import jaxpr_eqn_flops
+        fwd_jaxpr = jax.make_jaxpr(lambda p: model.apply(p, x, t))(params)
+        fwd_flops = sum(jaxpr_eqn_flops(e) for e in fwd_jaxpr.jaxpr.eqns)
+
+        def flops(latency):
+            return 3.0 * fwd_flops / latency / len(jax.devices()) / 1e12
+
+        tokens = case.batch_size
     elif case.family == "wresnet":
         import optax as _optax
         from alpa_tpu.model.wide_resnet import WResNetConfig, WideResNet

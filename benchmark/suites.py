@@ -83,6 +83,16 @@ suites = {
                       dict(num_layers=50, width_factor=2, num_classes=1000),
                       batch_size=32, dtype="float32"),
     ],
+    # diffusion UNet (ref suite_unet.py)
+    "unet.tiny": [
+        BenchmarkCase("unet-64", "unet",
+                      dict(block_channels=(64, 128, 256),
+                           layers_per_block=2,
+                           attention_resolutions=(2,), num_heads=4,
+                           time_embed_dim=256),
+                      batch_size=8, dtype="float32",
+                      method_kwargs=dict(resolution=32)),
+    ],
     # ---- auto-search suites (ref suite_auto_gpt.py / suite_auto_moe.py /
     # suite_wresnet.py): stage DP + per-stage ILP pick the plan ----
     "gpt.auto": [

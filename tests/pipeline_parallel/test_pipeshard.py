@@ -225,6 +225,31 @@ class TestPipeshardInference:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=1e-5)
 
+    def test_auto_stage_inference_objective(self):
+        """Forward-only pipelines use the inference DP objective
+        (minimize max stage cost; ref inference_dp,
+        stage_construction.py:403) and stay numerically correct."""
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            AutoStageOption)
+        from alpa_tpu.testing import create_mlp_train_state_and_batch
+
+        alpa_tpu.init(cluster="local")
+        state, batch = create_mlp_train_state_and_batch(batch_size=64,
+                                                        num_layers=4)
+
+        @alpa_tpu.parallelize(method=PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=4),
+            stage_option=AutoStageOption(),
+            pipeline_schedule="inference"), batch_argnums=(1,))
+        def forward(state, batch):
+            return state.apply_fn(state.params, batch["x"])
+
+        out = forward(state, batch)
+        ref = state.apply_fn(state.params, batch["x"])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=1e-5)
+
     def test_scalar_output_with_microbatching_errors(self):
         from alpa_tpu.testing import create_mlp_train_state_and_batch
 
