@@ -86,6 +86,31 @@ def config_from_spec(name: str, **kwargs) -> GPTConfig:
                      **kwargs)
 
 
+# OPT ladder: name -> (hidden, layers, heads); seq 2048, vocab 50272,
+# relu MLP, +2 positional offset (ref examples/llm_serving/model/
+# opt_model.py get_opt_config; 350m omitted — post-norm layout)
+opt_specs = {
+    "125m": (768, 12, 12),
+    "1.3b": (2048, 24, 32),
+    "2.7b": (2560, 32, 32),
+    "6.7b": (4096, 32, 32),
+    "13b": (5120, 40, 40),
+    "30b": (7168, 48, 56),
+    "66b": (9216, 64, 72),
+    "175b": (12288, 96, 96),
+}
+
+
+def config_from_opt_spec(name: str, **kwargs) -> GPTConfig:
+    """OPT-family GPTConfig (ref opt_model.py model table)."""
+    hidden, layers, heads = opt_specs[name.lower().replace("opt-", "")]
+    defaults = dict(vocab_size=50272, seq_len=2048, activation="relu",
+                    pos_offset=2, tie_embeddings=True)
+    defaults.update(kwargs)
+    return GPTConfig(hidden_size=hidden, num_layers=layers,
+                     num_heads=heads, **defaults)
+
+
 def reference_attention(q, k, v, *, causal: bool, offset=0, bias=None):
     """Plain einsum attention; XLA fuses this well on TPU for short seqs.
 

@@ -260,16 +260,21 @@ def get_model(name_or_config,
               rngkey=None) -> Generator:
     """Build a servable Generator (ref wrapper.py:501 get_model).
 
-    ``name_or_config``: a GPTConfig, or a ladder name like "gpt-125M"
-    (random-initialized — weight loading plugs in via ``params``).
+    ``name_or_config``: a GPTConfig, or a ladder name like "gpt-125M" /
+    "opt-2.7b" (random-initialized — weight loading plugs in via
+    ``params``; HF checkpoints via ``serve.get_hf_model``).
     """
-    from alpa_tpu.model.gpt_model import config_from_spec, init_gpt_real
+    from alpa_tpu.model.gpt_model import (config_from_opt_spec,
+                                          config_from_spec, init_gpt_real)
 
     if isinstance(name_or_config, GPTConfig):
         config = name_or_config
     else:
-        spec = str(name_or_config).split("-")[-1]
-        config = config_from_spec(spec)
+        name = str(name_or_config)
+        if name.lower().startswith("opt"):
+            config = config_from_opt_spec(name)
+        else:
+            config = config_from_spec(name.split("-")[-1])
     model = GPTModel(config)
     if params is None:
         model, params = init_gpt_real(config, batch_size, rngkey)

@@ -376,8 +376,9 @@ def jaxpr_eqn_flops(eqn) -> float:
         out = eqn.outvars[0].aval
         rhs = eqn.invars[1].aval
         return 2.0 * float(np.prod(out.shape)) * float(np.prod(rhs.shape[:-1]))
-    if prim in ("custom_jvp_call", "custom_vjp_call", "pjit", "closed_call",
-                "remat", "checkpoint", "custom_vjp_call_jaxpr"):
+    if prim in ("custom_jvp_call", "custom_vjp_call", "pjit", "jit",
+                "closed_call", "remat", "checkpoint",
+                "custom_vjp_call_jaxpr"):
         sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
         if sub is None:
             return 0.0
