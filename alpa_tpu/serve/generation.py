@@ -72,7 +72,15 @@ class Generator:
 
     def __init__(self, model: GPTModel, params, config: GPTConfig,
                  batch_size: int = 1,
-                 prompt_buckets: Optional[Sequence[int]] = None):
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 parallel_method: Optional[Any] = None):
+        """``parallel_method``: optional alpa_tpu ParallelMethod for the
+        prefill/decode executables — e.g. ``PipeshardParallel(
+        pipeline_schedule="inference")`` with a layer-marked model config
+        gives pipelined inference with per-stage-resident KV caches (ref
+        get_pipeshard_executable, opt_model.py:770); cache outputs keep
+        their stage placement so the next decode's device_put is a no-op.
+        """
         self.model = model
         self.params = params
         self.config = config
@@ -98,8 +106,15 @@ class Generator:
             logits, caches = model.apply(params, token, pos, caches)
             return logits[:, 0, :], caches
 
-        self._prefill = jax.jit(prefill)
-        self._decode = jax.jit(decode)
+        if parallel_method is not None:
+            import alpa_tpu
+            self._prefill = alpa_tpu.parallelize(
+                prefill, method=parallel_method, donate_argnums=())
+            self._decode = alpa_tpu.parallelize(
+                decode, method=parallel_method, donate_argnums=())
+        else:
+            self._prefill = jax.jit(prefill)
+            self._decode = jax.jit(decode)
         # beam-search KV-cache gather, compiled once (per cache shapes)
         self._reorder = jax.jit(
             lambda caches, idx: jax.tree_util.tree_map(
