@@ -170,6 +170,61 @@ def load_opt(model_name_or_model,
     return GPTModel(config), params, config
 
 
+def save_params_dir(params, path: str):
+    """Write a params pytree as one .npy file per leaf (ref the
+    numpy-per-parameter layout load_opt_params_worker_func consumes,
+    opt_model.py:865).  Leaf files are named by their tree path."""
+    import os
+
+    os.makedirs(path, exist_ok=True)
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    index = []
+    for p, leaf in flat:
+        name = jax.tree_util.keystr(p).replace("'", "").replace("[", "") \
+            .replace("]", ".").strip(".")
+        np.save(os.path.join(path, name + ".npy"), np.asarray(leaf))
+        index.append(name)
+    with open(os.path.join(path, "index.txt"), "w",
+              encoding="utf-8") as f:
+        f.write("\n".join(index))
+
+
+def load_params_dir(path: str, shardings, dtype=None):
+    """Load a ``save_params_dir`` layout straight into sharded arrays.
+
+    The 175B-class path (ref load_params_dis_array, opt_model.py:956):
+    each leaf file is memory-mapped and ``jax.make_array_from_callback``
+    reads ONLY the slices this process's addressable shards need — no
+    full parameter (let alone the full model) ever materializes in host
+    memory.  ``shardings``: pytree of NamedShardings congruent with the
+    saved params (None leaves = fully replicated on the first device set).
+    """
+    import os
+
+    flat_shardings = jax.tree_util.tree_leaves_with_path(
+        shardings, is_leaf=lambda t: t is None)
+    leaves = {}
+    for p, sh in flat_shardings:
+        name = jax.tree_util.keystr(p).replace("'", "").replace("[", "") \
+            .replace("]", ".").strip(".")
+        mm = np.load(os.path.join(path, name + ".npy"), mmap_mode="r")
+        if dtype is not None and mm.dtype != np.dtype(dtype):
+            # dtype conversion forfeits slice-laziness for this leaf
+            mm = np.asarray(mm, dtype)
+        if sh is None:
+            leaves[name] = jnp.asarray(mm)
+        else:
+            leaves[name] = jax.make_array_from_callback(
+                mm.shape, sh, lambda idx, mm=mm: np.asarray(mm[idx]))
+    # rebuild the tree in the shardings' structure
+    treedef = jax.tree_util.tree_structure(
+        shardings, is_leaf=lambda t: t is None)
+    ordered = [leaves[jax.tree_util.keystr(p).replace("'", "")
+                      .replace("[", "").replace("]", ".").strip(".")]
+               for p, _ in flat_shardings]
+    return jax.tree_util.tree_unflatten(treedef, ordered)
+
+
 def _place(params, dtype, shardings):
     if shardings is not None:
         # leaves stay numpy until device_put with the TARGET sharding —
