@@ -358,3 +358,66 @@ class TestExpertParallelStructure:
         np.testing.assert_allclose(np.asarray(out_sharded),
                                    np.asarray(out_ref), rtol=2e-5,
                                    atol=2e-5)
+
+
+class TestDynamicScale:
+    """Mixed-precision loss scaling (ref model_util.py TrainState +
+    dynamic scale): scale backs off on overflow, grows after a streak of
+    finite steps, and the update is jit-compatible inside a parallel
+    train step."""
+
+    def test_scale_state_machine(self):
+        from alpa_tpu.model.model_util import DynamicScaleState
+        s = DynamicScaleState.create(init_scale=1024.0)
+        s = s.replace(growth_interval=2)
+        # overflow -> backoff
+        s1 = s.update(jnp.bool_(False))
+        assert float(s1.scale) == 512.0
+        # two finite steps -> growth
+        s2 = s1.update(jnp.bool_(True))
+        assert float(s2.scale) == 512.0 and int(s2.fine_count) == 1
+        s3 = s2.update(jnp.bool_(True))
+        assert float(s3.scale) == 1024.0
+
+    def test_scaled_training_step(self):
+        from alpa_tpu.model.model_util import (TrainState, all_finite,
+                                               cross_entropy_loss)
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=16, vocab_size=64, dtype=jnp.bfloat16)
+        model = GPTModel(cfg)
+        rng = jax.random.PRNGKey(0)
+        ids = jax.random.randint(rng, (8, 16), 0, 64)
+        params = model.init(rng, ids)
+        state = TrainState.create_with_scale(
+            apply_fn=model.apply, params=params, tx=optax.sgd(1e-2),
+            use_dynamic_scale=True)
+
+        @alpa_tpu.parallelize(method=alpa_tpu.DataParallel(),
+                              donate_argnums=())
+        def train_step(state, batch):
+            ds = state.dynamic_scale
+
+            def loss_fn(p):
+                logits = state.apply_fn(p, batch["ids"])
+                return cross_entropy_loss(
+                    logits.astype(jnp.float32), batch["labels"]) * ds.scale
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+            grads = jax.tree_util.tree_map(lambda g: g / ds.scale, grads)
+            finite = all_finite(grads)
+            ds2 = ds.update(finite)
+            # only apply updates when grads are finite
+            new_state = state.apply_gradients(grads=jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads))
+            return new_state.replace(dynamic_scale=ds2), loss / ds.scale
+
+        batch = {"ids": ids,
+                 "labels": jax.random.randint(jax.random.PRNGKey(1),
+                                              (8, 16), 0, 64)}
+        losses = []
+        for _ in range(4):
+            state, loss = train_step(state, batch)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+        assert float(state.dynamic_scale.scale) >= 1.0
