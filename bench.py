@@ -7,10 +7,19 @@ Baseline anchor (BASELINE.md): the reference's published manual-3D GPT-2.6B
 result of 37.01 TFLOPS/GPU on 8x V100 (ref benchmark/alpa/README.md:89-101).
 vs_baseline = achieved TFLOPS-per-chip / 37.01.
 
-The remote-attached chip can wedge (observed: relay hangs on which even
-trivial programs never complete).  Run with ``--self-timeout SECONDS``
-(default 480) to guarantee a JSON line: the benchmark runs in a child
-process; on timeout the parent reports the failure instead of hanging.
+Chip-protection discipline (the remote-attached chip's relay wedges on
+near-OOM programs and stays wedged for a long time):
+
+1. **HBM hard gate** — every on-chip config is estimated (params + optimizer
+   state + activations) and refused outright above ``HBM_GATE_GB``.  The
+   refusal is an error line, not an attempt.
+2. **Probe-and-wait recovery** — before running the benchmark the parent
+   probes the chip with a tiny matmul in a child process.  If the relay is
+   wedged, it keeps probing every ``PROBE_INTERVAL_S`` until the self-budget
+   is nearly spent (wedges clear on their own), then runs the benchmark the
+   moment a probe succeeds.
+3. **Child-process isolation** — the benchmark itself runs in a child with a
+   hard timeout, so a wedge mid-run cannot hang the caller.
 """
 import json
 import os
@@ -20,40 +29,156 @@ import time
 
 BASELINE_TFLOPS_PER_DEVICE = 37.01
 
+# Relay ceiling, in *estimator* units.  estimate_hbm_gb is deliberately
+# conservative (it counts fp32 logits + their grad without assuming XLA
+# fuses or frees them): the known-good h2048-l16-bs8 config estimates
+# 15.6 GB and runs at 76 TFLOPS; every config that wedged the relay
+# (remat_policy="dots", batch 16, h2048-l24 with fp32 adam) estimates
+# >= 20.2 GB.  The gate sits between with margin on the safe side.
+HBM_GATE_GB = 16.0
 
-def _run_with_timeout(timeout: float) -> int:
+PROBE_INTERVAL_S = 60.0
+PROBE_TIMEOUT_S = 90.0
+BENCH_TIMEOUT_S = 480.0
+# Don't launch the heavy benchmark with less budget than compile + warmup
+# + 10 timed iters realistically need — a mid-run kill on a just-recovered
+# chip is itself a wedge risk.
+MIN_ATTEMPT_S = 240.0
+MAX_CHILD_FAILURES = 3
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "print(float((x @ x)[0, 0]))"
+)
+
+
+def gpt_param_count(hidden_size, num_layers, vocab_size, seq_len,
+                    mlp_ratio=4, tie_embeddings=True):
+    per_layer = (4 + 2 * mlp_ratio) * hidden_size ** 2 \
+        + (9 + 2 * mlp_ratio) * hidden_size  # biases + 2 LN
+    emb = vocab_size * hidden_size + seq_len * hidden_size
+    head = 0 if tie_embeddings else vocab_size * hidden_size
+    return per_layer * num_layers + emb + head + 2 * hidden_size
+
+
+def estimate_hbm_gb(config, batch_size, optimizer_bytes_per_param=8.0,
+                    chunked_ce=False):
+    """Estimated peak HBM for one train step of ``config`` at ``batch_size``.
+
+    params are fp32 (flax param_dtype default) = 4 B/p; optimizer state
+    defaults to fp32 adam (2 moments) = 8 B/p.  Activations assume
+    per-block remat: L boundary activations + one live block's
+    intermediates, in the compute dtype, plus fp32 logits (+ their grad)
+    unless the loss is chunked.
+    """
+    import numpy as np
+    p = gpt_param_count(config.hidden_size, config.num_layers,
+                        config.vocab_size, config.seq_len, config.mlp_ratio,
+                        config.tie_embeddings)
+    act_bytes = np.dtype(config.dtype).itemsize
+    tokens = batch_size * config.seq_len
+    h = config.hidden_size
+    # live block intermediates: qkv(3h) + attn scores/probs + proj(h) +
+    # mlp(4h + 4h) + residuals — call it ~20h per token (bs8/s1024
+    # attention scores are 32 MB/head-batch slice, negligible after fusing)
+    per_block = tokens * 20 * h * act_bytes
+    if getattr(config, "remat_blocks", False):
+        # per-block remat: keep only block boundaries + one live block
+        boundary = tokens * h * act_bytes * config.num_layers
+        block_peak = per_block
+        if getattr(config, "remat_policy", None) == "dots":
+            # saved dot outputs per layer: qkv 3h + proj h + mlp 5h ≈ 9h
+            boundary += tokens * 9 * h * act_bytes * config.num_layers
+    else:
+        # no remat: every layer's intermediates live until backward
+        boundary = per_block * config.num_layers
+        block_peak = 0
+    logits = 0 if chunked_ce else 2 * tokens * config.vocab_size * 4
+    total = p * (4.0 + optimizer_bytes_per_param) + boundary + block_peak \
+        + logits + tokens * h * 4  # grads materialize alongside fp32 master
+    return total / 1e9
+
+
+def _probe_once():
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           timeout=PROBE_TIMEOUT_S, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _run_inner(timeout):
+    """Run the benchmark child.
+
+    Returns ``(json_line_or_None, error_or_None)`` where ``error`` is
+    "timeout" or "rc=N: <stderr tail>" when no JSON line was produced.
+    """
     cmd = [sys.executable, os.path.abspath(__file__), "--inner"]
     try:
         r = subprocess.run(cmd, timeout=timeout, capture_output=True,
                            text=True)
-        # forward the child's (single) JSON line
-        for line in (r.stdout or "").splitlines():
-            if line.startswith("{"):
-                print(line)
-                return 0
-        sys.stderr.write(r.stderr[-2000:] if r.stderr else "")
-        print(json.dumps({
-            "metric": "gpt_train_tflops_per_chip", "value": 0.0,
-            "unit": "TFLOPS/chip", "vs_baseline": 0.0,
-            "detail": {"error": "bench child produced no result",
-                       "returncode": r.returncode},
-        }))
-        return 1
     except subprocess.TimeoutExpired:
-        print(json.dumps({
-            "metric": "gpt_train_tflops_per_chip", "value": 0.0,
-            "unit": "TFLOPS/chip", "vs_baseline": 0.0,
-            "detail": {"error": f"device unresponsive (> {timeout:.0f}s); "
-                       "last good on-chip result: 76.06 TFLOPS/chip "
-                       "(vs_baseline 2.055)"},
-        }))
-        return 1
+        return None, "timeout"
+    for line in (r.stdout or "").splitlines():
+        if line.startswith("{"):
+            return line, None
+    return None, f"rc={r.returncode}: {(r.stderr or '')[-800:]}"
+
+
+def _run_with_recovery(total_budget):
+    t0 = time.time()
+    probes = []
+    child_errors = []
+    while True:
+        remaining = total_budget - (time.time() - t0)
+        if remaining < PROBE_TIMEOUT_S + 30:
+            break
+        if len(child_errors) >= MAX_CHILD_FAILURES and \
+                child_errors[-1] != "timeout":
+            break  # deterministic child failure — retrying won't help
+        ok = _probe_once()
+        probes.append(ok)
+        remaining = total_budget - (time.time() - t0)
+        if ok:
+            if remaining < MIN_ATTEMPT_S:
+                break  # not enough budget for a safe full attempt
+            line, err = _run_inner(min(BENCH_TIMEOUT_S, remaining - 10))
+            if line is not None:
+                print(line)
+                # a gate refusal or measured failure carries detail.error;
+                # exit nonzero so the harness can tell it from a real score
+                try:
+                    rec = json.loads(line)
+                    return 1 if rec.get("detail", {}).get("error") else 0
+                except ValueError:
+                    return 0
+            child_errors.append(err)
+            sys.stderr.write(f"bench child failed ({err[:200]})\n")
+            if err != "timeout":
+                time.sleep(10)  # brief backoff before diagnosis retry
+        else:
+            time.sleep(min(PROBE_INTERVAL_S,
+                           max(0.0, total_budget - (time.time() - t0))))
+    print(json.dumps({
+        "metric": "gpt_train_tflops_per_chip", "value": 0.0,
+        "unit": "TFLOPS/chip", "vs_baseline": 0.0,
+        "detail": {
+            "error": ("bench child kept failing"
+                      if child_errors and child_errors[-1] != "timeout"
+                      else "device unresponsive for the whole bench window"),
+            "probe_history": ["ok" if p else "wedged" for p in probes],
+            "child_errors": child_errors[-3:],
+            "last_good_onchip": "76.06 TFLOPS/chip (vs_baseline 2.055)",
+        },
+    }))
+    return 1
 
 
 def main():
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
 
     import alpa_tpu
@@ -67,12 +192,9 @@ def main():
 
     if on_tpu:
         # GPT-1.3B-class config in bf16 (h2048 l16), batch 8 x seq 1024 —
-        # the winner of the on-chip sweeps (scripts/bench_sweep.py):
-        # 76.06 TFLOPS/chip.  Bigger models amortize dispatch overhead, so
-        # MFU rises with size (125M: 66.7) until the remote compile helper
-        # gives out (h2048 l24 / h2560 fail to compile).  XLA's fused
-        # attention beats the pallas flash kernel at these shapes (66.7 vs
-        # 47.7 on 125M) and per-block remat is required to fit l16 but
+        # the winner of the on-chip sweeps (scripts/bench_sweep.py).
+        # XLA's fused attention beats the pallas flash kernel at seq 1024
+        # (66.7 vs 47.7 on 125M); per-block remat is required to fit l16;
         # dense CE beats the chunked variant once logits fit (76.1 vs
         # 75.2).  Never raise batch above 8: the relay wedges.
         config = GPTConfig(hidden_size=2048, num_layers=16, num_heads=32,
@@ -84,6 +206,17 @@ def main():
         config = GPTConfig(hidden_size=256, num_layers=4, num_heads=8,
                            seq_len=256, vocab_size=1024, dtype=jnp.float32)
         batch_size = 8
+
+    if on_tpu:
+        est = estimate_hbm_gb(config, batch_size)
+        if est > HBM_GATE_GB:
+            print(json.dumps({
+                "metric": "gpt_train_tflops_per_chip", "value": 0.0,
+                "unit": "TFLOPS/chip", "vs_baseline": 0.0,
+                "detail": {"error": f"refused: estimated {est:.1f} GB HBM "
+                           f"> gate {HBM_GATE_GB} GB"},
+            }))
+            return
 
     alpa_tpu.init(cluster="local")
     model = GPTModel(config)
@@ -131,7 +264,7 @@ def main():
     tflops = compute_gpt_tflops(batch_size, config.seq_len, config.num_layers,
                                 config.hidden_size, config.vocab_size, n_dev,
                                 latency)
-    print(json.dumps({
+    result = {
         "metric": "gpt_train_tflops_per_chip",
         "value": round(tflops, 3),
         "unit": "TFLOPS/chip",
@@ -145,15 +278,30 @@ def main():
             "n_devices": n_dev,
             "platform": devices[0].platform,
         },
-    }))
+    }
+    print(json.dumps(result))
+    if on_tpu:
+        try:  # keep a committed on-chip history next to the suites
+            here = os.path.dirname(os.path.abspath(__file__))
+            os.makedirs(os.path.join(here, "benchmark", "results"),
+                        exist_ok=True)
+            with open(os.path.join(here, "benchmark", "results",
+                                   "onchip_log.jsonl"), "a") as f:
+                f.write(json.dumps(result) + "\n")
+        except OSError:
+            pass
 
 
 if __name__ == "__main__":
     if "--inner" in sys.argv:
         main()
+    elif "--probe" in sys.argv:
+        # single relay-health probe (used by scripts/chip_probe.sh so the
+        # probe program has exactly one definition)
+        sys.exit(0 if _probe_once() else 1)
     else:
-        timeout = 480.0
+        budget = 1380.0
         for i, a in enumerate(sys.argv):
             if a == "--self-timeout" and i + 1 < len(sys.argv):
-                timeout = float(sys.argv[i + 1])
-        sys.exit(_run_with_timeout(timeout))
+                budget = float(sys.argv[i + 1])
+        sys.exit(_run_with_recovery(budget))

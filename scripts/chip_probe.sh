@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the TPU relay every 10 min via bench.py --probe (the single probe
+# definition); append status lines.
+# Usage: scripts/chip_probe.sh [logfile] [interval_s] [max_iters]
+LOG=${1:-/tmp/chip_probe.log}
+INTERVAL=${2:-600}
+MAX=${3:-70}
+HERE=$(dirname "$(dirname "$(readlink -f "$0")")")
+for i in $(seq 1 "$MAX"); do
+  ts=$(date -u +%FT%TZ)
+  if python "$HERE/bench.py" --probe >/dev/null 2>&1; then
+    echo "$ts OK" >> "$LOG"
+  else
+    echo "$ts WEDGED" >> "$LOG"
+  fi
+  sleep "$INTERVAL"
+done

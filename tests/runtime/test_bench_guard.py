@@ -1,0 +1,106 @@
+"""Chip-protection discipline in bench.py (VERDICT r2 next #1).
+
+The estimator must separate the observed-good config from every config
+that has wedged the relay, and the recovery loop must always emit one
+JSON line.
+"""
+import dataclasses
+import io
+import json
+import sys
+
+import jax.numpy as jnp
+
+import bench
+from alpa_tpu.model.gpt_model import GPTConfig
+
+GOOD = GPTConfig(hidden_size=2048, num_layers=16, num_heads=32, seq_len=1024,
+                 vocab_size=51200, dtype=jnp.bfloat16, remat_blocks=True)
+
+
+def test_hbm_gate_separates_good_from_wedging_configs():
+    good = bench.estimate_hbm_gb(GOOD, 8)
+    assert good < bench.HBM_GATE_GB
+    # every historically wedging config must be refused
+    dots = bench.estimate_hbm_gb(
+        dataclasses.replace(GOOD, remat_policy="dots"), 8)
+    bs16 = bench.estimate_hbm_gb(GOOD, 16)
+    l24_fp32 = bench.estimate_hbm_gb(
+        dataclasses.replace(GOOD, num_layers=24), 8)
+    no_remat = bench.estimate_hbm_gb(
+        dataclasses.replace(GOOD, remat_blocks=False), 8)
+    for est in (dots, bs16, l24_fp32, no_remat):
+        assert est > bench.HBM_GATE_GB
+    assert no_remat > good  # dropping remat must not look cheaper
+    # the growth path stays open: l24 with bf16 moments + chunked CE fits
+    l24_lean = bench.estimate_hbm_gb(
+        dataclasses.replace(GOOD, num_layers=24), 8,
+        optimizer_bytes_per_param=4.0, chunked_ce=True)
+    assert l24_lean < bench.HBM_GATE_GB
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def time(self):
+        return self.now
+
+    def sleep(self, s):
+        self.now += s
+
+
+def _capture_recovery(monkeypatch, probe_results, inner_line, budget=400.0):
+    clock = _FakeClock()
+    probes = iter(probe_results)
+
+    def probe():
+        clock.now += 5.0  # a probe costs wall-clock even when mocked
+        return next(probes, False)
+
+    monkeypatch.setattr(bench, "_probe_once", probe)
+    monkeypatch.setattr(
+        bench, "_run_inner",
+        lambda timeout: (inner_line, None if inner_line else "rc=1: boom"))
+    monkeypatch.setattr(bench.time, "sleep", clock.sleep)
+    monkeypatch.setattr(bench.time, "time", clock.time)
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    rc = bench._run_with_recovery(budget)
+    return rc, out.getvalue()
+
+
+def test_recovery_emits_result_after_wedge_clears(monkeypatch):
+    line = json.dumps({"metric": "gpt_train_tflops_per_chip", "value": 76.0})
+    rc, out = _capture_recovery(monkeypatch, [False, False, True], line)
+    assert rc == 0
+    assert json.loads(out.strip())["value"] == 76.0
+
+
+def test_recovery_emits_zero_line_when_never_clears(monkeypatch):
+    rc, out = _capture_recovery(monkeypatch, [False] * 100, None)
+    assert rc == 1
+    rec = json.loads(out.strip())
+    assert rec["value"] == 0.0
+    assert "probe_history" in rec["detail"]
+
+
+def test_recovery_stops_on_deterministic_child_failure(monkeypatch):
+    # probe always ok, child always fails fast with rc=1: must stop after
+    # MAX_CHILD_FAILURES, not hammer the chip for the whole budget
+    rc, out = _capture_recovery(monkeypatch, [True] * 100, None, budget=3600)
+    assert rc == 1
+    rec = json.loads(out.strip())
+    assert rec["detail"]["error"] == "bench child kept failing"
+    assert len([p for p in rec["detail"]["probe_history"] if p == "ok"]) \
+        <= bench.MAX_CHILD_FAILURES
+
+
+def test_gate_refusal_returns_nonzero(monkeypatch):
+    refusal = json.dumps({"metric": "gpt_train_tflops_per_chip",
+                          "value": 0.0, "unit": "TFLOPS/chip",
+                          "vs_baseline": 0.0,
+                          "detail": {"error": "refused: estimated 20 GB"}})
+    rc, out = _capture_recovery(monkeypatch, [True], refusal)
+    assert rc == 1
+    assert json.loads(out.strip())["detail"]["error"].startswith("refused")
