@@ -544,6 +544,201 @@ def enumerate_reduce_strategies(eqn, logical_mesh) -> List[Strategy]:
     ]
 
 
+def enumerate_gather_strategies(eqn, logical_mesh) -> Optional[List[Strategy]]:
+    """Gather handler (the reference's C++ pass enumerates strategies for
+    the full HLO instruction set incl. gather — ref
+    playground/auto_sharding_solver/solver.py; absent here until r3).
+
+    Embedding lookups (``jnp.take(table, ids)``) are the headline case.
+    Each non-trivial mesh axis takes one role:
+
+      ('ib', k): shard the k-th indices batch dim — the matching output
+                 batch dim shards with it, no collective;
+      ('pt', d): shard a fully-sliced (passthrough) operand dim — e.g. the
+                 embedding feature dim; output offset dim shards, free;
+      ('ix', d): shard an indexed operand dim — vocab-parallel embedding:
+                 each shard gathers its local rows (GSPMD masks out-of-
+                 shard ids) and the partial outputs all-reduce.
+
+    Returns None (fall back to the generic barrier) for exotic forms
+    (batching dims, non-trailing index vector dim).
+    """
+    dn = eqn.params["dimension_numbers"]
+    if dn.operand_batching_dims or dn.start_indices_batching_dims:
+        return None
+    op_av, idx_av = eqn.invars[0].aval, eqn.invars[1].aval
+    out_av = eqn.outvars[0].aval
+    slice_sizes = eqn.params["slice_sizes"]
+    mesh_shape = logical_mesh.shape
+    op_ndim, idx_ndim, out_ndim = (len(op_av.shape), len(idx_av.shape),
+                                   len(out_av.shape))
+
+    offset_dims = list(dn.offset_dims)
+    batch_out_dims = [d for d in range(out_ndim) if d not in set(offset_dims)]
+    idx_batch_dims = list(range(idx_ndim - 1))  # index vector dim is last
+    if len(batch_out_dims) != len(idx_batch_dims):
+        return None
+    # operand dims surviving into the output, in order -> offset positions
+    passthrough = [d for d in range(op_ndim)
+                   if d not in set(dn.collapsed_slice_dims)]
+    if len(passthrough) != len(offset_dims):
+        return None
+    full_passthrough = [d for d in passthrough
+                        if slice_sizes[d] == op_av.shape[d]]
+    indexed = list(dn.start_index_map)
+
+    nontrivial = [a for a, s in enumerate(mesh_shape) if s > 1]
+    if not nontrivial:
+        return [Strategy("R", replicated_spec(out_ndim), 0.0,
+                         (replicated_spec(op_ndim),
+                          replicated_spec(idx_ndim)))]
+
+    role_choices = ([("ib", k) for k in range(len(idx_batch_dims))] +
+                    [("pt", d) for d in full_passthrough] +
+                    [("ix", d) for d in indexed])
+    strategies = []
+    seen = set()
+    for assignment in itertools.product(role_choices,
+                                        repeat=len(nontrivial)):
+        if len(set(assignment)) != len(assignment):
+            continue
+        op_map, idx_map, out_map = {}, {}, {}
+        ar_axes = []
+        for axis, (role, pos) in zip(nontrivial, assignment):
+            if role == "ib":
+                idx_map[idx_batch_dims[pos]] = axis
+                out_map[batch_out_dims[pos]] = axis
+            elif role == "pt":
+                op_map[pos] = axis
+                out_map[offset_dims[passthrough.index(pos)]] = axis
+            else:  # 'ix': vocab-parallel
+                op_map[pos] = axis
+                ar_axes.append(axis)
+        op_spec = make_spec(op_ndim, op_map)
+        idx_spec = make_spec(idx_ndim, idx_map)
+        out_spec = make_spec(out_ndim, out_map)
+        if not (spec_valid(op_av, op_spec, mesh_shape) and
+                spec_valid(idx_av, idx_spec, mesh_shape) and
+                spec_valid(out_av, out_spec, mesh_shape)):
+            continue
+        key = (op_spec, idx_spec, out_spec)
+        if key in seen:
+            continue
+        seen.add(key)
+        out_bytes = (float(np.prod(out_av.shape) or 1) *
+                     out_av.dtype.itemsize / num_shards(out_spec, mesh_shape))
+        cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
+                   for a in ar_axes)
+        name = "g" + "".join(f"{r}{p}@{a}" for a, (r, p) in
+                             zip(nontrivial, assignment))
+        strategies.append(Strategy(name, out_spec, cost,
+                                   (op_spec, idx_spec)))
+    if not strategies:
+        strategies.append(Strategy("R", replicated_spec(out_ndim), 0.0,
+                                   (replicated_spec(op_ndim),
+                                    replicated_spec(idx_ndim))))
+    return strategies
+
+
+SCATTER_PRIMS = frozenset({"scatter", "scatter-add", "scatter-mul",
+                           "scatter-min", "scatter-max"})
+
+
+def enumerate_scatter_strategies(eqn, logical_mesh) -> Optional[List[Strategy]]:
+    """Scatter handler — the transpose of gather (embedding-gradient
+    ``scatter-add`` is the headline case; KV-cache writes that lower to
+    scatter take the same roles).  Output has the operand's shape.
+
+      ('w', d):  shard a window (passthrough) operand dim — updates shard
+                 along with it, no collective;
+      ('sc', d): shard a scattered operand dim — vocab-parallel table:
+                 each shard applies the updates landing in its rows
+                 (GSPMD masks the rest), updates replicated, free;
+      ('ub', k): shard the k-th updates batch dim — each shard scatters
+                 its slice of updates, the operand-shaped partials
+                 all-reduce (grad-accumulation pattern).
+    """
+    dn = eqn.params["dimension_numbers"]
+    if dn.operand_batching_dims or dn.scatter_indices_batching_dims:
+        return None
+    op_av, idx_av, upd_av = (eqn.invars[0].aval, eqn.invars[1].aval,
+                             eqn.invars[2].aval)
+    out_av = eqn.outvars[0].aval
+    mesh_shape = logical_mesh.shape
+    op_ndim, idx_ndim, upd_ndim = (len(op_av.shape), len(idx_av.shape),
+                                   len(upd_av.shape))
+
+    window_dims = list(dn.update_window_dims)  # positions in updates
+    upd_batch_dims = [d for d in range(upd_ndim)
+                      if d not in set(window_dims)]
+    idx_batch_dims = list(range(idx_ndim - 1))
+    if len(upd_batch_dims) != len(idx_batch_dims):
+        return None
+    # operand window dims (not inserted), in order -> update window positions
+    op_window = [d for d in range(op_ndim)
+                 if d not in set(dn.inserted_window_dims)]
+    if len(op_window) != len(window_dims):
+        return None
+    full_window = [d for d in op_window
+                   if upd_av.shape[window_dims[op_window.index(d)]] ==
+                   op_av.shape[d]]
+    scattered = list(dn.scatter_dims_to_operand_dims)
+
+    nontrivial = [a for a, s in enumerate(mesh_shape) if s > 1]
+    if not nontrivial:
+        return [Strategy("R", replicated_spec(op_ndim), 0.0,
+                         (replicated_spec(op_ndim), replicated_spec(idx_ndim),
+                          replicated_spec(upd_ndim)))]
+
+    role_choices = ([("w", d) for d in full_window] +
+                    [("sc", d) for d in scattered] +
+                    [("ub", k) for k in range(len(upd_batch_dims))])
+    strategies = []
+    seen = set()
+    for assignment in itertools.product(role_choices,
+                                        repeat=len(nontrivial)):
+        if len(set(assignment)) != len(assignment):
+            continue
+        op_map, idx_map, upd_map = {}, {}, {}
+        ar_axes = []
+        for axis, (role, pos) in zip(nontrivial, assignment):
+            if role == "w":
+                op_map[pos] = axis
+                upd_map[window_dims[op_window.index(pos)]] = axis
+            elif role == "sc":
+                op_map[pos] = axis
+            else:  # 'ub'
+                upd_map[upd_batch_dims[pos]] = axis
+                idx_map[idx_batch_dims[pos]] = axis
+                ar_axes.append(axis)
+        op_spec = make_spec(op_ndim, op_map)
+        idx_spec = make_spec(idx_ndim, idx_map)
+        upd_spec = make_spec(upd_ndim, upd_map)
+        if not (spec_valid(op_av, op_spec, mesh_shape) and
+                spec_valid(idx_av, idx_spec, mesh_shape) and
+                spec_valid(upd_av, upd_spec, mesh_shape)):
+            continue
+        key = (op_spec, idx_spec, upd_spec)
+        if key in seen:
+            continue
+        seen.add(key)
+        out_bytes = (float(np.prod(out_av.shape) or 1) *
+                     out_av.dtype.itemsize / num_shards(op_spec, mesh_shape))
+        cost = sum(logical_mesh.all_reduce_cost(out_bytes, a)
+                   for a in ar_axes)
+        name = "s" + "".join(f"{r}{p}@{a}" for a, (r, p) in
+                             zip(nontrivial, assignment))
+        # out spec == operand spec (scatter writes in place)
+        strategies.append(Strategy(name, op_spec, cost,
+                                   (op_spec, idx_spec, upd_spec)))
+    if not strategies:
+        strategies.append(Strategy("R", replicated_spec(op_ndim), 0.0,
+                                   (replicated_spec(op_ndim),
+                                    replicated_spec(idx_ndim),
+                                    replicated_spec(upd_ndim))))
+    return strategies
+
+
 ########################################
 # follow-through dim mappings
 ########################################
@@ -643,6 +838,15 @@ def follow_dimmap(eqn, operand_idx: int) -> Optional[DimMap]:
     if prim in ("pad", "slice", "dynamic_slice"):
         if len(in_shape) == len(out_shape):
             return identity_dimmap(len(out_shape))
+        return None
+    if prim == "dynamic_update_slice":
+        # KV-cache writes: the output follows the cache operand dim-for-dim
+        # (and, for the update operand, on every dim whose extent matches —
+        # the updated dim stays unmapped so its sharding isn't forced onto
+        # the smaller update).  GSPMD executes the sharded in-place update.
+        if len(in_shape) == len(out_shape):
+            return tuple(d if in_shape[d] == out_shape[d] else None
+                         for d in range(len(out_shape)))
         return None
     return None
 
@@ -807,6 +1011,32 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
             var_node[eqn.outvars[0]] = (n.idx,
                                         identity_dimmap(len(out_av.shape)))
             continue
+
+        if prim == "gather" or prim in SCATTER_PRIMS:
+            if prim == "gather":
+                strategies = enumerate_gather_strategies(eqn, logical_mesh)
+            else:
+                strategies = enumerate_scatter_strategies(eqn, logical_mesh)
+            if strategies is not None:
+                out_av = eqn.outvars[0].aval
+                n = new_node("op", out_av, strategies,
+                             f"{prim}:{out_av.shape}", outvar=eqn.outvars[0])
+                n_operands = len(strategies[0].operand_specs)
+                for oi in range(n_operands):
+                    v = eqn.invars[oi]
+                    if isinstance(v, Literal):
+                        continue
+                    src = get_source(v)
+                    if src is None:
+                        continue
+                    src_idx, dimmap = src
+                    req = [st.operand_specs[oi] for st in strategies]
+                    C = edge_cost_matrix(nodes[src_idx], dimmap, v.aval, req)
+                    edges.append(Edge(src_idx, n.idx, C))
+                var_node[eqn.outvars[0]] = (
+                    n.idx, identity_dimmap(len(out_av.shape)))
+                continue
+            # exotic gather/scatter forms fall through to the barrier
 
         # Free nodes: ops whose inputs are all literals/scalars (constant
         # broadcasts, iota, zeros_like chains).  Materializing any sharding
