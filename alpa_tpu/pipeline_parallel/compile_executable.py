@@ -167,7 +167,8 @@ def compile_pipeshard_executable(fun: Callable,
             num_layers, virtual_mesh, stage_option,
             num_micro_batches=num_micro_batches,
             layer_comps=fwd_comps,
-            auto_sharding_option=as_option)
+            auto_sharding_option=as_option,
+            schedule=pipeline_schedule)
     num_stages = len(fwd_stage_layer_ids)
 
     # merge layer computations into stage computations

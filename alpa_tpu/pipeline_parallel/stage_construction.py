@@ -75,9 +75,21 @@ def get_submesh_choices(num_hosts: int, num_devices_per_host: int,
         i *= 2
     assert choices[-1][1] == num_devices_per_host, (
         "num_devices_per_host must be a power of two")
-    for k in range(2, num_hosts + 1):
-        if space == "all" or num_hosts % k == 0 or space == "power_of_two":
+    if space == "all":
+        for k in range(2, num_hosts + 1):
             choices.append((k, num_devices_per_host))
+    elif space == "power_of_two":
+        k = 2
+        while k <= num_hosts:
+            choices.append((k, num_devices_per_host))
+            k *= 2
+    elif space == "small_power_of_two":
+        k = 2
+        while k <= min(num_hosts, 4):
+            choices.append((k, num_devices_per_host))
+            k *= 2
+    else:
+        raise ValueError(f"invalid submesh space: {space!r}")
     return choices
 
 
@@ -150,7 +162,8 @@ def cluster_layers_and_slice_mesh(
         donation_mapping=None,
         num_micro_batches: int = 1,
         auto_sharding_option=None,
-        objective: str = "training"):
+        objective: str = "training",
+        schedule: str = "1f1b"):
     """Decide (forward_stage_layer_ids, submeshes, logical shapes, per-stage
     autosharding dicts) (ref cluster_layers_and_slice_mesh:571)."""
     stage_option = stage_option or UniformStageOption()
@@ -169,7 +182,8 @@ def cluster_layers_and_slice_mesh(
         from alpa_tpu.pipeline_parallel.stage_dp import auto_stage_dp
         return auto_stage_dp(num_forward_layers, virtual_mesh, stage_option,
                              layer_flops, layer_comps, num_micro_batches,
-                             auto_sharding_option, objective=objective)
+                             auto_sharding_option, objective=objective,
+                             schedule=schedule)
 
     # Uniform: num_stages = num_hosts (or all devices as equal slices)
     num_stages = (stage_option.num_stages if isinstance(

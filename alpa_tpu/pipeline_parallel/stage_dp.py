@@ -31,28 +31,52 @@ _CSRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_NATIVE_DIR)),
 _lib = None
 _lib_tried = False
 
+# Must match csrc/stage_dp.cc kAbiVersion.  A stale .so called through a
+# newer ctypes signature silently corrupts the output buffers, so the
+# loader refuses any library that can't prove the right version.
+_ABI_VERSION = 2
+
+# inflight_mode codes (csrc/stage_dp.cc inflight_count)
+_INFLIGHT_MODES = {"1f1b": 0, "pipedream_flush": 0, "gpipe": 1,
+                   "1f1b_overlap_friendly": 2, "inference": 3}
+
 
 def _load_native():
-    """Load (building if needed) the C++ DP solver."""
+    """Load (building if needed) the C++ DP solver.
+
+    ``make`` runs unconditionally — it is timestamp-incremental, so this is
+    a no-op when the .so is fresh, and it transparently rebuilds after a
+    source change (an in-place upgrade otherwise keeps a stale binary with
+    an incompatible ABI).
+    """
     global _lib, _lib_tried
     if _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH):
-        makefile = os.path.join(_CSRC_DIR, "Makefile")
-        if os.path.exists(makefile):
-            try:
-                subprocess.run(["make", "-C", _CSRC_DIR], check=True,
-                               capture_output=True, timeout=120)
-            except Exception as e:  # pylint: disable=broad-except
-                logger.warning("building libstage_dp.so failed: %s", e)
+    makefile = os.path.join(_CSRC_DIR, "Makefile")
+    if os.path.exists(makefile):
+        try:
+            subprocess.run(["make", "-C", _CSRC_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning("building libstage_dp.so failed: %s", e)
     if os.path.exists(_LIB_PATH):
         try:
             lib = ctypes.CDLL(_LIB_PATH)
+            try:
+                lib.stage_dp_abi_version.restype = ctypes.c_int32
+                abi = int(lib.stage_dp_abi_version())
+            except AttributeError:
+                abi = -1
+            if abi != _ABI_VERSION:
+                logger.warning(
+                    "libstage_dp.so ABI %d != expected %d (stale build?); "
+                    "using the Python fallback", abi, _ABI_VERSION)
+                return None
             lib.stage_dp_solve.restype = ctypes.c_int
             lib.stage_dp_solve.argtypes = [
                 ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-                ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32,
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
                 np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
@@ -73,15 +97,19 @@ def stage_dp_solve(costs: np.ndarray,
                    num_micro_batches: int,
                    mem_param: Optional[np.ndarray] = None,
                    mem_act: Optional[np.ndarray] = None,
-                   mem_budget: float = 0.0
+                   mem_budget: float = 0.0,
+                   inflight_mode: str = "1f1b"
                    ) -> Optional[List[Tuple[int, int, int]]]:
     """Solve the stage-construction DP.
 
     costs: (L, L, M) float64; costs[i, j, m] = cost of layers i..j (incl.)
     on submesh m (inf = infeasible).  Memory feasibility is position-aware
     (ref max_n_succ_stages, stage_profiling.py:756): the s-th stage from
-    the pipeline end holds min(s, B) in-flight microbatches under 1F1B, so
-    the check is ``mem_param + min(s, B) * mem_act <= mem_budget``.
+    the pipeline end holds inflight(s) microbatches of activations, where
+    inflight depends on the schedule (``inflight_mode``): "1f1b" min(s, B),
+    "gpipe" B, "1f1b_overlap_friendly" min(2s-1, B) (eager forwards),
+    "inference" 1 (forward-only — nothing stacks).  The check is
+    ``mem_param + inflight(s) * mem_act <= mem_budget``.
     Returns list of (start_layer, end_layer_exclusive, submesh_idx) or
     None if infeasible.
     """
@@ -94,13 +122,14 @@ def stage_dp_solve(costs: np.ndarray,
         mem_act = np.zeros_like(costs)
     mem_param = np.ascontiguousarray(mem_param, np.float64)
     mem_act = np.ascontiguousarray(mem_act, np.float64)
+    mode = _INFLIGHT_MODES.get(inflight_mode, 0)
 
     lib = _load_native()
     if lib is not None:
         starts = np.zeros(L, np.int32)
         meshes = np.zeros(L, np.int32)
-        S = lib.stage_dp_solve(L, M, num_devices, num_micro_batches, costs,
-                               sizes, mem_param, mem_act, mem_budget,
+        S = lib.stage_dp_solve(L, M, num_devices, num_micro_batches, mode,
+                               costs, sizes, mem_param, mem_act, mem_budget,
                                starts, meshes)
         if S < 0:
             return None
@@ -110,13 +139,24 @@ def stage_dp_solve(costs: np.ndarray,
             out.append((int(starts[t]), int(end), int(meshes[t])))
         return out
     return _stage_dp_python(costs, sizes, num_devices, num_micro_batches,
-                            mem_param, mem_act, mem_budget)
+                            mem_param, mem_act, mem_budget, mode)
 
 
-def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget):
+def _inflight_count(s, B, mode):
+    b = max(B, 1)
+    if mode == 1:  # gpipe
+        return b
+    if mode == 2:  # overlap-friendly 1f1b
+        return min(2 * s - 1, b)
+    if mode == 3:  # inference
+        return 1
+    return min(s, b)  # 1f1b
+
+
+def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget, mode=0):
     """Pure-Python fallback, same algorithm as csrc/stage_dp.cc
     (f[l][d][s] with the suffix-stage-count dimension for position-aware
-    1F1B memory feasibility)."""
+    schedule-dependent memory feasibility)."""
     L, _, M = C.shape
     INF = float("inf")
     finite = C[np.isfinite(C)]
@@ -135,7 +175,7 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget):
         for l in range(L - 1, -1, -1):
             for d in range(1, D + 1):
                 for s in range(1, L - l + 1):
-                    inflight = min(s, max(B, 1))
+                    inflight = _inflight_count(s, B, mode)
                     for j in range(l, L):
                         for m in range(M):
                             n = int(sizes[m])
@@ -184,7 +224,7 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget):
 
 def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                   layer_comps, num_micro_batches, auto_sharding_option,
-                  objective: str = "training"):
+                  objective: str = "training", schedule: str = "1f1b"):
     """Fill the cost tensor with the static cost model and run the DP
     (ref cluster_layers_and_slice_mesh auto branch, stage_construction.py:
     571 + SURVEY.md §3.4)."""
@@ -217,11 +257,13 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
     # size-dependent sec/flop and per-collective alpha-beta in real
     # seconds, so the DP's decisions trace back to measurements.
     from alpa_tpu.mesh_profiling import (calibration_from_file,
-                                         get_global_calibration)
+                                         get_effective_calibration)
     db_file = getattr(stage_option, "profiling_database_filename", None)
     cal = calibration_from_file(db_file) if db_file else None
     if cal is None:
-        cal = get_global_calibration()
+        # measured DB backfilled with analytic per-generation link
+        # constants on TPU (published ICI bandwidths; VERDICT r2 next #8)
+        cal = get_effective_calibration()
 
     use_ilp_cost = not getattr(stage_option, "use_hlo_cost_model", True) or \
         (L * L * M <= 256)
@@ -272,10 +314,17 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
     # objective="inference" (ref inference_dp, stage_construction.py:403):
     # a forward-only pipeline's throughput is bottlenecked by the slowest
     # stage, so minimize max stage cost first (sum as tie-break) — the
-    # training objective with B -> large.
-    B_eff = num_micro_batches if objective == "training" else 4096
+    # training objective with B -> large.  The memory feasibility check is
+    # decoupled from B_eff via inflight_mode: a forward-only pipeline holds
+    # ~1 microbatch per stage regardless of the objective's B, and training
+    # schedules each have their own in-flight profile.
+    if objective == "inference":
+        B_eff, inflight_mode = 4096, "inference"
+    else:
+        B_eff, inflight_mode = num_micro_batches, schedule
     part = stage_dp_solve(costs, sizes, D, B_eff, mem_param,
-                          mem_act, mem_budget=mem_budget)
+                          mem_act, mem_budget=mem_budget,
+                          inflight_mode=inflight_mode)
     if part is None:
         raise RuntimeError(
             "auto stage construction found no feasible partition")

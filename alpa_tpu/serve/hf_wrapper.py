@@ -40,7 +40,7 @@ class WrappedInferenceModel:
                  length_penalty: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  pad_token_id: Optional[int] = None,
-                 seed: int = 0,
+                 seed: Optional[int] = None,
                  **unused_kwargs) -> np.ndarray:
         """HF-``GenerationMixin``-shaped generate.
 
@@ -75,6 +75,10 @@ class WrappedInferenceModel:
                                do_sample=do_sample, temperature=temperature,
                                top_k=top_k, eos_token_id=eos)
         import jax
+        if seed is None:
+            # fresh entropy per call, matching HF GenerationMixin: repeated
+            # do_sample calls on the same prompt must not repeat samples
+            seed = int(np.random.SeedSequence().entropy % (2 ** 63))
         rng = jax.random.PRNGKey(seed)
         if attention_mask is not None:
             mask = _to_numpy(attention_mask)

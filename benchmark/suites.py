@@ -30,6 +30,11 @@ GPT_SPECS = {
     "1.3B": dict(hidden_size=2048, num_layers=24, num_heads=32),
     "2.6B": dict(hidden_size=2560, num_layers=32, num_heads=32),
     "6.7B": dict(hidden_size=4096, num_layers=32, num_heads=32),
+    # upper rungs of the ladder (ref suite_manual_gpt.py:24-26); used by
+    # compile-only cases — far beyond a single chip's HBM
+    "15B": dict(hidden_size=5120, num_layers=48, num_heads=40),
+    "39B": dict(hidden_size=8192, num_layers=48, num_heads=64),
+    "76B": dict(hidden_size=10240, num_layers=60, num_heads=80),
 }
 
 

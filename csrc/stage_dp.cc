@@ -15,15 +15,21 @@
 // cost <= t_max.
 //
 // Memory feasibility is position-aware (the reference's max_n_succ_stages,
-// stage_profiling.py:756): under 1F1B, the s-th stage from the END holds
-// min(s, B) in-flight microbatches of activations, so the budget check for
-// a candidate stage is
-//   mem_param + min(s, B) * mem_act <= mem_budget
+// stage_profiling.py:756): the s-th stage from the END holds some number of
+// in-flight microbatches of activations that depends on the schedule, so
+// the budget check for a candidate stage is
+//   mem_param + inflight(s) * mem_act <= mem_budget
 // which requires the suffix-stage count s as a DP dimension (the
-// reference's f[s][layer][devices] state).
+// reference's f[s][layer][devices] state).  inflight_mode selects the
+// schedule's in-flight profile:
+//   0 = 1F1B:             min(s, B)
+//   1 = GPipe:            B        (all microbatches live before backward)
+//   2 = overlap-friendly: min(2s-1, B)  (eager forwards hold ~2x)
+//   3 = inference:        1        (forward-only, nothing stacks)
 //
 // Exported C ABI (ctypes):
-//   int stage_dp_solve(L, M, D, B, C[L*L*M], n_devices[M],
+//   int stage_dp_abi_version() -> kAbiVersion (loader refuses a stale .so)
+//   int stage_dp_solve(L, M, D, B, inflight_mode, C[L*L*M], n_devices[M],
 //                      mem_param[L*L*M], mem_act[L*L*M], mem_budget,
 //                      out_starts[L], out_meshes[L]) ->
 //   number of stages (or -1 if infeasible). Stage t covers layers
@@ -37,6 +43,17 @@
 namespace {
 
 constexpr double kInf = std::numeric_limits<double>::infinity();
+constexpr int32_t kAbiVersion = 2;
+
+double inflight_count(int s, int B, int32_t mode) {
+  const int b = B > 0 ? B : 1;
+  switch (mode) {
+    case 1:  return b;                          // gpipe
+    case 2:  return std::min(2 * s - 1, b);     // overlap-friendly 1f1b
+    case 3:  return 1.0;                        // inference
+    default: return std::min(s, b);             // 1f1b
+  }
+}
 
 struct DPResult {
   double total;
@@ -46,7 +63,8 @@ struct DPResult {
 
 // DP for a fixed t_max: f[l][d][s] = min total cost covering layers l..L-1
 // with exactly d devices left in exactly s stages.
-bool run_dp(int L, int M, int D, int B, const double* C, const int64_t* ndev,
+bool run_dp(int L, int M, int D, int B, int32_t inflight_mode,
+            const double* C, const int64_t* ndev,
             const double* mem_param, const double* mem_act,
             double mem_budget, double t_max, DPResult* out) {
   const int stride_j = M;
@@ -65,9 +83,8 @@ bool run_dp(int L, int M, int D, int B, const double* C, const int64_t* ndev,
       for (int s = 1; s <= L - l; ++s) {
         double best = kInf;
         int bj = -1, bm = -1;
-        // in-flight microbatches for the stage s-from-the-end under 1F1B
-        const double inflight =
-            static_cast<double>(std::min(s, B > 0 ? B : 1));
+        // in-flight microbatches for the stage s-from-the-end
+        const double inflight = inflight_count(s, B, inflight_mode);
         for (int j = l; j < L; ++j) {
           const double* row = C + l * stride_i + j * stride_j;
           const double* prow = mem_param + l * stride_i + j * stride_j;
@@ -128,7 +145,10 @@ bool run_dp(int L, int M, int D, int B, const double* C, const int64_t* ndev,
 
 extern "C" {
 
+int32_t stage_dp_abi_version() { return kAbiVersion; }
+
 int stage_dp_solve(int32_t L, int32_t M, int32_t D, int32_t B,
+                   int32_t inflight_mode,
                    const double* C, const int64_t* n_devices,
                    const double* mem_param, const double* mem_act,
                    double mem_budget, int32_t* out_starts,
@@ -153,8 +173,8 @@ int stage_dp_solve(int32_t L, int32_t M, int32_t D, int32_t B,
   DPResult cur;
   for (double t_max : candidates) {
     if (best_obj < kInf && (B - 1) * t_max >= best_obj) break;
-    if (!run_dp(L, M, D, B, C, n_devices, mem_param, mem_act, mem_budget,
-                t_max, &cur))
+    if (!run_dp(L, M, D, B, inflight_mode, C, n_devices, mem_param, mem_act,
+                mem_budget, t_max, &cur))
       continue;
     const double obj = cur.total + (B - 1) * t_max;
     if (obj < best_obj) {
