@@ -543,15 +543,14 @@ def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
 ########################################
 
 
-def profile_stage_cost(stage_comps, num_devices: int, as_option,
-                       niter: int = 3) -> float:
-    """Compile + time one candidate stage on the first ``num_devices``
-    available devices (ref ProfileWorker._profile_impl,
-    stage_profiling.py:321: real submesh, dummy inputs).
+def compile_stage_candidate(stage_comps, num_devices: int, as_option):
+    """Plan + compile one candidate stage on the first ``num_devices``
+    available devices; returns ``(jitted, args)`` ready for timing.
 
     The candidate runs under the SAME intra-op planner the final compile
-    uses, so the measured time includes its collectives.  Ends in a
-    scalar readback (true fence on remote-attached chips).
+    uses, so the measured time includes its collectives.  Compilation is
+    thread-safe (XLA compiles under the hood), so candidates compile
+    concurrently; the *timing* must stay serial.
     """
     import jax
     import jax.numpy as jnp
@@ -598,38 +597,107 @@ def profile_stage_cost(stage_comps, num_devices: int, as_option,
               if in_shardings is not None else jax.jit(wrapped))
     args = [jnp.zeros(a.shape, a.dtype) if hasattr(a, "shape") else 0
             for a in avals]
-    float(jitted(*args))  # compile + warmup
+    float(jitted(*args))  # compile + one warmup execution
+    return jitted, args
+
+
+def time_compiled_candidate(jitted, args, niter: int = 3) -> float:
+    """Serially time a compiled candidate; ends in a scalar readback
+    (the only true fence on remote-attached chips)."""
     tic = time.perf_counter()
+    val = None
     for _ in range(niter):
         val = jitted(*args)
     float(val)
     return (time.perf_counter() - tic) / niter
 
 
+def profile_stage_cost(stage_comps, num_devices: int, as_option,
+                       niter: int = 3) -> float:
+    """Compile + time one candidate stage (ref ProfileWorker._profile_impl,
+    stage_profiling.py:321: real submesh, dummy inputs)."""
+    jitted, args = compile_stage_candidate(stage_comps, num_devices,
+                                           as_option)
+    return time_compiled_candidate(jitted, args, niter)
+
+
+def shortlist_candidates(costs, submesh_sizes, n_avail, limit: int):
+    """Pick candidates to measure, bucketed by (span length, submesh) so
+    refinement touches the stage spans the DP actually considers instead
+    of only the globally cheapest (= shortest-span) entries (ADVICE r2).
+    Round-robins over buckets in modeled-cost order until ``limit``."""
+    L, _, M = costs.shape
+    buckets: Dict[Tuple[int, int], List[Tuple[float, int, int, int]]] = {}
+    for i in range(L):
+        for j in range(i, L):
+            for m in range(M):
+                if np.isfinite(costs[i, j, m]) and \
+                        submesh_sizes[m] <= n_avail:
+                    buckets.setdefault((j - i, m), []).append(
+                        (costs[i, j, m], i, j, m))
+    for b in buckets.values():
+        b.sort()
+    out = []
+    rank = 0
+    while len(out) < limit and any(len(b) > rank for b in buckets.values()):
+        for key in sorted(buckets):
+            b = buckets[key]
+            if rank < len(b) and len(out) < limit:
+                out.append(b[rank])
+        rank += 1
+    return out
+
+
 def refine_costs_measured(costs, layer_comps, submesh_sizes, as_option,
-                          limit: int = 16):
+                          limit: int = 16, compile_workers: int = 4):
     """Replace the most promising cost-model entries with measured times
     (the TPU adaptation of ref get_compute_cost's full profile sweep,
     SURVEY.md §7 hard part 2: cost model as default, real profiling as
-    refinement).  Candidates are shortlisted by modeled cost; at most
-    ``limit`` are compiled + timed in this process.  Returns the number
-    of entries refined.
+    refinement).
+
+    Industrial shape (ref CompileWorkerPool/ProfileWorkerPool,
+    stage_profiling.py:291): candidates are shortlisted per (span,
+    submesh) bucket, COMPILED concurrently on a thread pool, then TIMED
+    serially (concurrent timing would corrupt the measurements).
+    Failures are surfaced as warnings and the count is returned; if every
+    candidate fails, raises so a broken measured mode can't silently
+    masquerade as the cost model.
     """
+    import concurrent.futures
+
     import jax
 
-    L, _, M = costs.shape
     n_avail = len(jax.devices())
-    cands = [(costs[i, j, m], i, j, m)
-             for i in range(L) for j in range(i, L) for m in range(M)
-             if np.isfinite(costs[i, j, m]) and submesh_sizes[m] <= n_avail]
-    cands.sort()
+    cands = shortlist_candidates(costs, submesh_sizes, n_avail, limit)
+    if not cands:
+        return 0
+    compiled = {}
+    failures = []
+    with concurrent.futures.ThreadPoolExecutor(compile_workers) as pool:
+        futs = {
+            pool.submit(compile_stage_candidate, layer_comps[i:j + 1],
+                        int(submesh_sizes[m]), as_option): (i, j, m)
+            for _cost, i, j, m in cands
+        }
+        for fut in concurrent.futures.as_completed(futs):
+            ijm = futs[fut]
+            try:
+                compiled[ijm] = fut.result()
+            except Exception as e:  # pylint: disable=broad-except
+                failures.append((ijm, repr(e)))
+                logger.warning("measured profile: compiling %s failed: %s",
+                               ijm, e)
     refined = 0
-    for _cost, i, j, m in cands[:limit]:
+    for (i, j, m), (jitted, args) in sorted(compiled.items()):
         try:
-            costs[i, j, m] = profile_stage_cost(
-                layer_comps[i:j + 1], int(submesh_sizes[m]), as_option)
+            costs[i, j, m] = time_compiled_candidate(jitted, args)
             refined += 1
         except Exception as e:  # pylint: disable=broad-except
-            logger.debug("measured profile (%d,%d,%d) failed: %s",
-                         i, j, m, e)
+            failures.append(((i, j, m), repr(e)))
+            logger.warning("measured profile: timing (%d,%d,%d) failed: %s",
+                           i, j, m, e)
+    if not refined and failures:
+        raise RuntimeError(
+            f"measured stage profiling failed for all {len(failures)} "
+            f"candidates; first: {failures[0]}")
     return refined

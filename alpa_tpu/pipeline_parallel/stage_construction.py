@@ -59,6 +59,13 @@ class AutoStageOption(StageOption):
     profiling_mode: str = "cost_model"
     # max candidates compiled+timed in "measured" mode
     measured_candidates_limit: int = 16
+    # concurrent compile workers for "measured" mode (timing stays serial)
+    measured_compile_workers: int = 4
+    # Path to an .npz caching the (costs, mem_param, mem_act) tensors for
+    # this model+mesh (the analog of ref compute-cost-<time>.npy,
+    # stage_profiling.py:53).  Loaded when the content key matches;
+    # recomputed and overwritten otherwise.
+    cached_compute_cost: Optional[str] = None
     # Per-device memory budget in bytes (None = unconstrained).
     memory_budget_per_device: Optional[float] = None
 

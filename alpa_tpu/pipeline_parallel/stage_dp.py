@@ -218,6 +218,50 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget, mode=0):
 
 
 ########################################
+# compute-cost tensor disk cache
+########################################
+
+
+def compute_cost_cache_key(layer_comps, choices, profiling_mode) -> str:
+    """Content key: the layers' jaxprs + the submesh search space + the
+    profiling mode.  Any change invalidates the cache."""
+    import hashlib
+    h = hashlib.sha256()
+    for c in layer_comps:
+        h.update(str(c.closed_jaxpr() if hasattr(c, "closed_jaxpr")
+                     else c).encode())
+    h.update(repr(list(choices)).encode())
+    h.update(profiling_mode.encode())
+    return h.hexdigest()[:16]
+
+
+def load_compute_cost_cache(path, key, shape):
+    """(costs, mem_param, mem_act) from ``path`` if the stored key and
+    shapes match, else None."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["key"]) != key or z["costs"].shape != shape:
+                logger.info("compute-cost cache %s stale (key/shape "
+                            "mismatch); recomputing", path)
+                return None
+            return z["costs"], z["mem_param"], z["mem_act"]
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning("compute-cost cache %s unreadable: %s", path, e)
+        return None
+
+
+def save_compute_cost_cache(path, key, costs, mem_param, mem_act):
+    try:
+        np.savez(path, key=np.str_(key), costs=costs, mem_param=mem_param,
+                 mem_act=mem_act)
+        logger.info("auto-stage DP: saved compute-cost cache %s", path)
+    except OSError as e:
+        logger.warning("saving compute-cost cache %s failed: %s", path, e)
+
+
+########################################
 # orchestration: cost tensor + DP -> stage assignment
 ########################################
 
@@ -270,34 +314,59 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
     mem_budget = float(
         getattr(stage_option, "memory_budget_per_device", None) or 0.0)
 
-    costs = np.full((L, L, M), np.inf)
-    mem_param = np.zeros((L, L, M))
-    mem_act = np.zeros((L, L, M))
-    for m, (h, d) in enumerate(choices):
-        # cost-model-only logical mesh of the candidate submesh shape
-        shape = (h * d, 1) if h == 1 else (h, d)
-        logical = LogicalDeviceMesh(
-            None, np.arange(h * d).reshape(shape),
-            mesh_beta=(0.1 if h > 1 else 0.01, 0.01),
-            calibration=cal)
-        for i in range(L):
-            for j in range(i, L):
-                comps = layer_comps[i:j + 1]
-                kwargs = {"use_ilp": use_ilp_cost}
-                if cal is not None:
-                    kwargs["sec_per_flop"] = cal.sec_per_flop
-                costs[i, j, m] = estimate_stage_cost(
-                    comps, logical, auto_sharding_option, **kwargs)
-                if mem_budget > 0:
-                    mem_param[i, j, m], mem_act[i, j, m] = \
-                        estimate_stage_memory_split(comps, logical)
+    # Disk cache of the cost tensors (ref compute-cost-<time>.npy,
+    # stage_profiling.py:53), keyed by the model + search-space content so
+    # auto-stage decisions are reproducible across runs without re-running
+    # the cost model / measured sweep.
+    cache_file = getattr(stage_option, "cached_compute_cost", None)
+    cache_key = None
+    if cache_file:
+        cache_key = compute_cost_cache_key(
+            layer_comps, choices,
+            getattr(stage_option, "profiling_mode", "cost_model"))
+        cached = load_compute_cost_cache(cache_file, cache_key, (L, L, M))
+        if cached is not None:
+            costs, mem_param, mem_act = cached
+            logger.info("auto-stage DP: loaded compute-cost cache %s",
+                        cache_file)
+            cache_file = None  # hit: skip recompute + rewrite
 
-    if getattr(stage_option, "profiling_mode", "cost_model") == "measured":
-        from alpa_tpu.mesh_profiling import refine_costs_measured
-        n = refine_costs_measured(
-            costs, layer_comps, sizes, auto_sharding_option,
-            limit=getattr(stage_option, "measured_candidates_limit", 16))
-        logger.info("measured stage profiling refined %d candidates", n)
+    if cache_key is None or cache_file:
+        costs = np.full((L, L, M), np.inf)
+        mem_param = np.zeros((L, L, M))
+        mem_act = np.zeros((L, L, M))
+        for m, (h, d) in enumerate(choices):
+            # cost-model-only logical mesh of the candidate submesh shape
+            shape = (h * d, 1) if h == 1 else (h, d)
+            logical = LogicalDeviceMesh(
+                None, np.arange(h * d).reshape(shape),
+                mesh_beta=(0.1 if h > 1 else 0.01, 0.01),
+                calibration=cal)
+            for i in range(L):
+                for j in range(i, L):
+                    comps = layer_comps[i:j + 1]
+                    kwargs = {"use_ilp": use_ilp_cost}
+                    if cal is not None:
+                        kwargs["sec_per_flop"] = cal.sec_per_flop
+                    costs[i, j, m] = estimate_stage_cost(
+                        comps, logical, auto_sharding_option, **kwargs)
+                    if mem_budget > 0:
+                        mem_param[i, j, m], mem_act[i, j, m] = \
+                            estimate_stage_memory_split(comps, logical)
+
+        if getattr(stage_option, "profiling_mode",
+                   "cost_model") == "measured":
+            from alpa_tpu.mesh_profiling import refine_costs_measured
+            n = refine_costs_measured(
+                costs, layer_comps, sizes, auto_sharding_option,
+                limit=getattr(stage_option, "measured_candidates_limit", 16),
+                compile_workers=getattr(stage_option,
+                                        "measured_compile_workers", 4))
+            logger.info("measured stage profiling refined %d candidates", n)
+
+        if cache_file:
+            save_compute_cost_cache(cache_file, cache_key, costs, mem_param,
+                                    mem_act)
 
     # stage_imbalance_tolerance: cap the DP's max-stage-cost threshold at
     # tolerance * (best perfectly-balanced stage cost estimate).
