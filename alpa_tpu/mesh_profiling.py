@@ -510,25 +510,42 @@ def estimate_stage_cost(stage_comps,
 def estimate_stage_memory_split(stage_comps,
                                 logical_mesh: LogicalDeviceMesh
                                 ) -> Tuple[float, float]:
-    """(per-device param bytes, per-microbatch activation bytes).
+    """(per-device param bytes, per-device per-microbatch activation
+    bytes).
 
-    Split so the stage DP can apply the position-aware 1F1B in-flight
-    factor (ref max_n_succ_stages, stage_profiling.py:756): total =
-    param + min(stages_from_end, B) * act.
+    Split so the stage DP can apply the position-aware schedule-dependent
+    in-flight factor (ref max_n_succ_stages, stage_profiling.py:756):
+    total = param + inflight(stages_from_end, B) * act.
+
+    Activations = outvars the stage actually produces; vars that merely
+    pass through (appear among the stage's invars, e.g. parameters
+    forwarded across layer slices) are excluded, and duplicates across the
+    stage's layer comps count once.  Both terms divide by the submesh size:
+    the intra-op planner shards parameters AND activations across it.
     """
+    produced = {id(v) for c in stage_comps for v in c.outvars}
     param_bytes = 0.0
-    act_bytes = 0.0
+    stage_inputs = set()
     for c in stage_comps:
         for v in c.invars:
-            if hasattr(v.aval, "shape"):
-                param_bytes += float(np.prod(v.aval.shape) or 1) * \
-                    v.aval.dtype.itemsize
+            if id(v) in produced or id(v) in stage_inputs or \
+                    not hasattr(v.aval, "shape"):
+                continue
+            stage_inputs.add(id(v))
+            param_bytes += float(np.prod(v.aval.shape) or 1) * \
+                v.aval.dtype.itemsize
+    act_bytes = 0.0
+    counted = set()
+    for c in stage_comps:
         for v in c.outvars:
-            if hasattr(v.aval, "shape"):
-                act_bytes += float(np.prod(v.aval.shape) or 1) * \
-                    v.aval.dtype.itemsize
+            if id(v) in counted or id(v) in stage_inputs or \
+                    not hasattr(v.aval, "shape"):
+                continue
+            counted.add(id(v))
+            act_bytes += float(np.prod(v.aval.shape) or 1) * \
+                v.aval.dtype.itemsize
     n = max(logical_mesh.num_devices, 1)
-    return param_bytes / n, act_bytes
+    return param_bytes / n, act_bytes / n
 
 
 def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,

@@ -268,6 +268,76 @@ def compile_pipeshard_executable(fun: Callable,
     )
 
 
+def search_pipeshard_plan(fun: Callable,
+                          virtual_mesh: VirtualPhysicalMesh,
+                          in_avals: Sequence[Any],
+                          batch_invars: Sequence[bool],
+                          num_micro_batches: int,
+                          as_option,
+                          pipeline_schedule: str = "1f1b",
+                          layer_option: Optional[LayerOption] = None,
+                          stage_option: Optional[StageOption] = None
+                          ) -> Dict[str, Any]:
+    """Plan-only auto search: trace, slice layers, run the stage DP — no
+    stage compilation, no devices needed (``virtual_mesh`` may be fully
+    virtual).  Returns a JSON-friendly solution record, the analog of the
+    reference's recorded auto-search results (ref
+    benchmark/alpa/suite_auto_gpt.py:71-84 "solution" tuples).
+
+    Used to produce committed plan artifacts for models far beyond the
+    attached hardware (e.g. GPT-6.7B on 8 virtual devices).
+    """
+    tic = time.time()
+    num_micro_batches = num_micro_batches or 1
+    layer_option = layer_option or AutoLayerOption(layer_num=8)
+
+    batch_flat_idx = [i for i, b in enumerate(batch_invars) if b]
+    micro_avals = list(in_avals)
+    for i in batch_flat_idx:
+        a = in_avals[i]
+        assert a.shape[0] % num_micro_batches == 0
+        micro_avals[i] = jax.ShapeDtypeStruct(
+            (a.shape[0] // num_micro_batches,) + tuple(a.shape[1:]), a.dtype)
+
+    set_current_layer_option(layer_option)
+    try:
+        closed_jaxpr = jax.make_jaxpr(lambda *a: fun(*a))(*micro_avals)
+    finally:
+        set_current_layer_option(None)
+
+    global_invars = list(closed_jaxpr.jaxpr.invars)
+    compute_eqns, grad_pairs, _apply_eqns = \
+        split_compute_grad_and_apply_grad(closed_jaxpr)
+    compute_jaxpr = clone_jaxpr(closed_jaxpr, eqns=compute_eqns,
+                                outvars=[p for p, _ in grad_pairs])
+    computations, _meta = slice_closed_jaxpr_by_full_pipeline_marks(
+        compute_jaxpr)
+    computations = \
+        mark_missing_vars_in_backward_computation_pipeline_marks(
+            computations, global_invars)
+    computations = pipeline_dce(computations, compute_jaxpr.jaxpr.outvars)
+    fwd_comps = [c for c in computations
+                 if not _is_backward_name(c.name)]
+
+    fwd_stage_layer_ids, submeshes, _logical_shapes, _as_dicts = \
+        cluster_layers_and_slice_mesh(
+            len(fwd_comps), virtual_mesh, stage_option,
+            num_micro_batches=num_micro_batches,
+            layer_comps=fwd_comps,
+            auto_sharding_option=as_option,
+            schedule=pipeline_schedule)
+    return {
+        "num_layers": len(fwd_comps),
+        "num_micro_batches": num_micro_batches,
+        "pipeline_schedule": pipeline_schedule,
+        "num_stages": len(fwd_stage_layer_ids),
+        "forward_stage_layer_ids": [list(map(int, ids))
+                                    for ids in fwd_stage_layer_ids],
+        "submesh_shapes": [list(map(int, s.shape)) for s in submeshes],
+        "search_seconds": round(time.time() - tic, 2),
+    }
+
+
 def _has_grad_marker(eqn) -> bool:
     from alpa_tpu.pipeline_parallel.primitive_def import is_marker
     return is_marker(eqn, "grad")

@@ -49,8 +49,10 @@ class AutoStageOption(StageOption):
     submesh_logical_shape_space: str = "single_node_model_parallel"
     # Prune DP thresholds above tolerance * (best balanced stage cost).
     stage_imbalance_tolerance: float = np.inf
-    # False -> include the intra-op ILP objective in stage costs even for
-    # large search spaces (slower, more accurate).
+    # True (default): exact merged-span ILP comm costs for small search
+    # spaces, additive per-layer ILP (prefix sums) for large ones.
+    # False: exact merged-span ILP everywhere (slower; large merged spans
+    # may hit the solver time limit).
     use_hlo_cost_model: bool = True
     profiling_database_filename: Optional[str] = None
     # "cost_model" (default) | "measured": compile + time the shortlisted

@@ -309,7 +309,14 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
         # constants on TPU (published ICI bandwidths; VERDICT r2 next #8)
         cal = get_effective_calibration()
 
-    use_ilp_cost = not getattr(stage_option, "use_hlo_cost_model", True) or \
+    # Span cost estimation strategy: exact merged-span ILP for small
+    # search spaces (or when forced via use_hlo_cost_model=False);
+    # otherwise ADDITIVE per-layer ILP — L*M solves whose prefix sums give
+    # every span.  Running the merged ILP on huge spans is both slow and
+    # wrong: past the solver time limit the greedy fallback returns
+    # replication-heavy plans whose comm terms invert the cost ladder
+    # (wide submeshes looked slower than one device).
+    exact_ilp = not getattr(stage_option, "use_hlo_cost_model", True) or \
         (L * L * M <= 256)
     mem_budget = float(
         getattr(stage_option, "memory_budget_per_device", None) or 0.0)
@@ -342,17 +349,32 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                 None, np.arange(h * d).reshape(shape),
                 mesh_beta=(0.1 if h > 1 else 0.01, 0.01),
                 calibration=cal)
-            for i in range(L):
-                for j in range(i, L):
-                    comps = layer_comps[i:j + 1]
-                    kwargs = {"use_ilp": use_ilp_cost}
-                    if cal is not None:
-                        kwargs["sec_per_flop"] = cal.sec_per_flop
-                    costs[i, j, m] = estimate_stage_cost(
-                        comps, logical, auto_sharding_option, **kwargs)
-                    if mem_budget > 0:
+            kwargs = {}
+            if cal is not None:
+                kwargs["sec_per_flop"] = cal.sec_per_flop
+            if exact_ilp:
+                for i in range(L):
+                    for j in range(i, L):
+                        costs[i, j, m] = estimate_stage_cost(
+                            layer_comps[i:j + 1], logical,
+                            auto_sharding_option, use_ilp=True, **kwargs)
+            else:
+                per_layer = [
+                    estimate_stage_cost([layer_comps[l]], logical,
+                                        auto_sharding_option, use_ilp=True,
+                                        **kwargs)
+                    for l in range(L)
+                ]
+                pref = np.concatenate([[0.0], np.cumsum(per_layer)])
+                for i in range(L):
+                    for j in range(i, L):
+                        costs[i, j, m] = pref[j + 1] - pref[i]
+            if mem_budget > 0:
+                for i in range(L):
+                    for j in range(i, L):
                         mem_param[i, j, m], mem_act[i, j, m] = \
-                            estimate_stage_memory_split(comps, logical)
+                            estimate_stage_memory_split(
+                                layer_comps[i:j + 1], logical)
 
         if getattr(stage_option, "profiling_mode",
                    "cost_model") == "measured":
