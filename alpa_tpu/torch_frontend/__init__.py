@@ -35,8 +35,9 @@ def get_mode() -> str:
     return _mode
 
 
-def functionalize(module, concrete_args=None):
-    """torch.nn.Module -> (jax_fn, params).
+def functionalize(module, concrete_args=None, split_buffers=False):
+    """torch.nn.Module -> (jax_fn, params), or with ``split_buffers=True``
+    (jax_fn, trainable, buffers) — see converter.functionalize.
 
     The mode is consulted at CALL time, so ``set_mode`` may be called
     before or after conversion: "local" runs the function under jax.jit
@@ -44,11 +45,12 @@ def functionalize(module, concrete_args=None):
     """
     import functools
     import jax
-    fn, params = _functionalize(module, concrete_args)
+    out = _functionalize(module, concrete_args, split_buffers)
+    fn = out[0]
     jitted = jax.jit(fn)
 
     @functools.wraps(fn)
     def dispatch(p, *inputs):
         return (jitted if _mode == "local" else fn)(p, *inputs)
 
-    return dispatch, params
+    return (dispatch,) + tuple(out[1:])

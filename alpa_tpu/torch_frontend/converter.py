@@ -157,6 +157,104 @@ def _max_pool2d(x, kernel_size, stride=None, padding=0, **_):
          (padding[1], padding[1])])
 
 
+def _avg_pool2d(x, kernel_size, stride=None, padding=0,
+                count_include_pad=True, **_):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    pads = [(0, 0), (0, 0), (padding[0], padding[0]),
+            (padding[1], padding[1])]
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add,
+                              (1, 1) + tuple(kernel_size),
+                              (1, 1) + tuple(stride), pads)
+    if count_include_pad or padding == (0, 0):
+        return s / (kernel_size[0] * kernel_size[1])
+    ones = jnp.ones_like(x)
+    denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                  (1, 1) + tuple(kernel_size),
+                                  (1, 1) + tuple(stride), pads)
+    return s / denom
+
+
+def _conv_transpose2d(x, w, b=None, stride=1, padding=0, output_padding=0,
+                      groups=1, dilation=1):
+    """torch F.conv_transpose2d: weight is (I, O/g, kH, kW); realized as a
+    fractionally-strided conv (lhs_dilation) of the spatially-flipped,
+    transposed kernel."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    stride, padding = pair(stride), pair(padding)
+    output_padding, dilation = pair(output_padding), pair(dilation)
+    i_total, o_per_g, kh, kw = w.shape
+    i_per_g = i_total // groups
+    # (I, O/g, kh, kw) -> (O, I/g, kh, kw), flipped spatially
+    wt = w.reshape(groups, i_per_g, o_per_g, kh, kw)
+    wt = jnp.flip(wt, axis=(-2, -1)).transpose(0, 2, 1, 3, 4)
+    wt = wt.reshape(groups * o_per_g, i_per_g, kh, kw)
+    dkh, dkw = (kh - 1) * dilation[0] + 1, (kw - 1) * dilation[1] + 1
+    pads = [(dkh - 1 - padding[0], dkh - 1 - padding[0] + output_padding[0]),
+            (dkw - 1 - padding[1], dkw - 1 - padding[1] + output_padding[1])]
+    y = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1), padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    if b is not None:
+        y = y + b[None, :, None, None]
+    return y
+
+
+def _group_norm(x, num_groups, w=None, b=None, eps=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape(n, num_groups, c // num_groups, *x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = g.mean(axes, keepdims=True)
+    var = ((g - mean)**2).mean(axes, keepdims=True)
+    y = ((g - mean) / jnp.sqrt(var + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if w is not None:
+        y = y * w.reshape(shape)
+    if b is not None:
+        y = y + b.reshape(shape)
+    return y
+
+
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                training=False, momentum=0.1, eps=1e-5):
+    # eval-mode semantics: normalize with running statistics (the
+    # functionalized frontend traces modules in eval mode)
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    y = (x - running_mean.reshape(shape)) / jnp.sqrt(
+        running_var.reshape(shape) + eps)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+def _scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                  is_causal=False, scale=None, **_):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if is_causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        scores = jnp.where(causal, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+
 # name -> callable; covers torch.nn.functional + tensor methods + operators
 FUNCTION_MAP: Dict[str, Callable] = {
     "linear": _linear,
@@ -170,9 +268,14 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "log_softmax": lambda x, dim=-1, **_: jax.nn.log_softmax(x, axis=dim),
     "dropout": lambda x, p=0.5, training=False, inplace=False: x,
     "layer_norm": _layer_norm,
+    "group_norm": _group_norm,
+    "batch_norm": _batch_norm,
     "embedding": _embedding,
     "conv2d": _conv2d,
+    "conv_transpose2d": _conv_transpose2d,
+    "scaled_dot_product_attention": _scaled_dot_product_attention,
     "max_pool2d": _max_pool2d,
+    "avg_pool2d": _avg_pool2d,
     "adaptive_avg_pool2d": lambda x, out: _adaptive_avg_pool2d(x, out),
     "matmul": jnp.matmul,
     "bmm": jnp.matmul,
@@ -180,9 +283,38 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "sub": operator.sub,
     "mul": operator.mul,
     "truediv": operator.truediv,
+    "floordiv": operator.floordiv,
     "div": jnp.divide,
     "neg": operator.neg,
     "pow": operator.pow,
+    # in-place torch ops are pure in fx-to-jax land
+    "iadd": operator.add,
+    "isub": operator.sub,
+    "imul": operator.mul,
+    "itruediv": operator.truediv,
+    "relu_": jax.nn.relu,
+    "add_": operator.add,
+    "mul_": operator.mul,
+    "clamp": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    "clamp_": lambda x, min=None, max=None: jnp.clip(x, min, max),
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "where": jnp.where,
+    "tril": jnp.tril,
+    "triu": jnp.triu,
+    "cumsum": lambda x, dim=-1, **_: jnp.cumsum(x, axis=dim),
+    "argmax": lambda x, dim=None, keepdim=False: jnp.argmax(
+        x, axis=dim, keepdims=keepdim),
+    "argmin": lambda x, dim=None, keepdim=False: jnp.argmin(
+        x, axis=dim, keepdims=keepdim),
+    "arange": jnp.arange,
+    "ones": lambda *s, dtype=None, device=None, **_: jnp.ones(
+        s[0] if len(s) == 1 and isinstance(s[0], (tuple, list)) else s),
+    "zeros": lambda *s, dtype=None, device=None, **_: jnp.zeros(
+        s[0] if len(s) == 1 and isinstance(s[0], (tuple, list)) else s),
+    "repeat": lambda x, *reps: jnp.tile(
+        x, reps[0] if len(reps) == 1 and isinstance(reps[0], (tuple, list))
+        else reps),
     "exp": jnp.exp,
     "log": jnp.log,
     "sqrt": jnp.sqrt,
@@ -274,20 +406,87 @@ def _convert_module(mod, params_prefix: str):
     if isinstance(mod, torch.nn.MaxPool2d):
         ks, st, pd = mod.kernel_size, mod.stride, mod.padding
         return lambda p, x: _max_pool2d(x, ks, st, pd)
-    if isinstance(mod, torch.nn.BatchNorm2d):
+    if isinstance(mod, (torch.nn.BatchNorm1d, torch.nn.BatchNorm2d,
+                        torch.nn.BatchNorm3d)):
         eps = mod.eps
         def f(p, x):
-            mean = p[f"{params_prefix}running_mean"]
-            var = p[f"{params_prefix}running_var"]
-            w = p.get(f"{params_prefix}weight")
-            b = p.get(f"{params_prefix}bias")
-            y = (x - mean[None, :, None, None]) / jnp.sqrt(
-                var[None, :, None, None] + eps)
-            if w is not None:
-                y = y * w[None, :, None, None]
-            if b is not None:
-                y = y + b[None, :, None, None]
-            return y
+            return _batch_norm(x, p[f"{params_prefix}running_mean"],
+                               p[f"{params_prefix}running_var"],
+                               p.get(f"{params_prefix}weight"),
+                               p.get(f"{params_prefix}bias"), eps=eps)
+        return f
+    if isinstance(mod, torch.nn.GroupNorm):
+        ng, eps = mod.num_groups, mod.eps
+        def f(p, x):
+            return _group_norm(x, ng, p.get(f"{params_prefix}weight"),
+                               p.get(f"{params_prefix}bias"), eps)
+        return f
+    if isinstance(mod, torch.nn.ConvTranspose2d):
+        stride, padding = mod.stride, mod.padding
+        output_padding, groups = mod.output_padding, mod.groups
+        dilation = mod.dilation
+        def f(p, x):
+            return _conv_transpose2d(x, p[f"{params_prefix}weight"],
+                                     p.get(f"{params_prefix}bias"), stride,
+                                     padding, output_padding, groups,
+                                     dilation)
+        return f
+    if isinstance(mod, torch.nn.AvgPool2d):
+        ks, st, pd = mod.kernel_size, mod.stride, mod.padding
+        cip = mod.count_include_pad
+        return lambda p, x: _avg_pool2d(x, ks, st, pd, cip)
+    if isinstance(mod, torch.nn.AdaptiveAvgPool2d):
+        out = mod.output_size
+        return lambda p, x: _adaptive_avg_pool2d(x, out)
+    if isinstance(mod, torch.nn.Identity):
+        return lambda p, x: x
+    if isinstance(mod, torch.nn.MultiheadAttention):
+        if not mod._qkv_same_embed_dim:
+            raise NotImplementedError(
+                "MultiheadAttention with distinct kdim/vdim has no jax "
+                "mapping yet")
+        nh, e, batch_first = mod.num_heads, mod.embed_dim, mod.batch_first
+
+        def f(p, q, k, v, key_padding_mask=None, need_weights=True,
+              attn_mask=None, average_attn_weights=True, is_causal=False):
+            w_in = p[f"{params_prefix}in_proj_weight"]
+            b_in = p.get(f"{params_prefix}in_proj_bias")
+            w_out = p[f"{params_prefix}out_proj.weight"]
+            b_out = p.get(f"{params_prefix}out_proj.bias")
+            if not batch_first:  # torch default: (L, B, E)
+                q, k, v = (jnp.swapaxes(t, 0, 1) for t in (q, k, v))
+
+            def proj(x, lo):
+                y = x @ w_in[lo:lo + e].T
+                return y + b_in[lo:lo + e] if b_in is not None else y
+
+            qp, kp, vp = proj(q, 0), proj(k, e), proj(v, 2 * e)
+
+            def split(x):  # (B, L, E) -> (B, nh, L, E/nh)
+                b_, l_, _ = x.shape
+                return x.reshape(b_, l_, nh, e // nh).transpose(0, 2, 1, 3)
+
+            mask = None
+            if key_padding_mask is not None:
+                # True = ignore, torch convention -> additive -inf
+                mask = jnp.where(key_padding_mask[:, None, None, :],
+                                 -jnp.inf, 0.0)
+            if attn_mask is not None:
+                am = (jnp.where(attn_mask, -jnp.inf, 0.0)
+                      if attn_mask.dtype == jnp.bool_ else attn_mask)
+                mask = am if mask is None else mask + am
+            out = _scaled_dot_product_attention(
+                split(qp), split(kp), split(vp), attn_mask=mask,
+                is_causal=is_causal)
+            b_, _, l_, _ = out.shape
+            out = out.transpose(0, 2, 1, 3).reshape(b_, l_, e)
+            out = out @ w_out.T
+            if b_out is not None:
+                out = out + b_out
+            if not batch_first:
+                out = jnp.swapaxes(out, 0, 1)
+            return out, None  # need_weights path returns no weights
+
         return f
     raise NotImplementedError(
         f"torch module {type(mod).__name__} has no jax mapping yet")
@@ -369,11 +568,18 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
     return fn
 
 
-def functionalize(module, concrete_args=None):
+def functionalize(module, concrete_args=None, split_buffers=False):
     """torch.nn.Module -> (jax_fn, params_dict).
 
     jax_fn(params, *jax_inputs) reproduces module.forward in eval mode
     (ref: the functionalized nn of alpa/torch/nn/).
+
+    With ``split_buffers=True`` returns (jax_fn, trainable, buffers):
+    ``trainable`` holds entries backed by torch Parameters, ``buffers``
+    the rest (BatchNorm running stats, ``num_batches_tracked``, ...).
+    Differentiate w.r.t. ``trainable`` only and call
+    ``jax_fn({**trainable, **buffers}, ...)`` — integer buffers would
+    otherwise break jax.grad and running stats must not receive updates.
     """
     import torch
     import torch.fx
@@ -385,4 +591,9 @@ def functionalize(module, concrete_args=None):
         for k, v in {**dict(module.state_dict())}.items()
     }
     fn = fx_to_jax(gm, params)
+    if split_buffers:
+        pnames = {k for k, _ in module.named_parameters()}
+        trainable = {k: v for k, v in params.items() if k in pnames}
+        buffers = {k: v for k, v in params.items() if k not in pnames}
+        return fn, trainable, buffers
     return fn, params

@@ -22,6 +22,18 @@ def _compare(module, *torch_inputs, rtol=1e-4):
     return fn, params, jax_inputs
 
 
+class _MHAWrap(torch.nn.Module):
+    """Self-attention through nn.MultiheadAttention as an fx leaf."""
+
+    def __init__(self, mha):
+        super().__init__()
+        self.mha = mha
+
+    def forward(self, x):
+        out, _ = self.mha(x, x, x)
+        return out
+
+
 class TestConversion:
 
     def test_mlp(self):
@@ -78,6 +90,59 @@ class TestConversion:
         ).eval()
         _compare(m, torch.randn(2, 3, 8, 8))
 
+    def test_avg_pools_and_group_norm(self):
+        m = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 8, 3, padding=1),
+            torch.nn.GroupNorm(4, 8),
+            torch.nn.ReLU(),
+            torch.nn.AvgPool2d(2),
+            torch.nn.AdaptiveAvgPool2d((1, 1)),
+            torch.nn.Flatten(1),
+        ).eval()
+        _compare(m, torch.randn(2, 3, 8, 8))
+
+    def test_conv_transpose2d(self):
+        for groups, opad in ((1, 0), (2, 1)):
+            m = torch.nn.Sequential(
+                torch.nn.ConvTranspose2d(4, 6, 3, stride=2, padding=1,
+                                         output_padding=opad,
+                                         groups=groups)).eval()
+            _compare(m, torch.randn(2, 4, 5, 5))
+
+    def test_batch_norm_1d(self):
+        m = torch.nn.Sequential(torch.nn.Linear(8, 16),
+                                torch.nn.BatchNorm1d(16)).eval()
+        # populate non-trivial running stats
+        with torch.no_grad():
+            m[1].running_mean += torch.randn(16) * 0.1
+            m[1].running_var += torch.rand(16)
+        _compare(m, torch.randn(4, 8))
+
+    def test_multihead_attention(self):
+        for batch_first in (True, False):
+            m = torch.nn.MultiheadAttention(16, 4,
+                                            batch_first=batch_first).eval()
+            # trace through a wrapper module so fx sees a call_module node
+            wrap = _MHAWrap(m).eval()
+            fn, params = functionalize(wrap)
+            x = torch.randn((2, 6, 16) if batch_first else (6, 2, 16))
+            with torch.no_grad():
+                expected = wrap(x).numpy()
+            got = np.asarray(fn(params, jnp.asarray(x.numpy())))
+            np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+    def test_scaled_dot_product_attention(self):
+
+        class Net(torch.nn.Module):
+
+            def forward(self, q, k, v):
+                return torch.nn.functional.scaled_dot_product_attention(
+                    q, k, v, is_causal=True)
+
+        q = torch.randn(2, 4, 8, 16)
+        _compare(Net(), q, torch.randn(2, 4, 8, 16),
+                 torch.randn(2, 4, 8, 16))
+
     def test_unmapped_op_clear_error(self):
 
         class Net(torch.nn.Module):
@@ -124,6 +189,105 @@ class TestTrainConverted:
             params, opt_state, loss = step(params, opt_state, x, y)
             losses.append(float(loss))
         assert losses[-1] < losses[0] * 0.8, losses
+
+
+def _make_resnet18(num_classes=10):
+    """Stock torchvision resnet18 structure, built directly in torch
+    (torchvision isn't installed in this image; this is the same
+    BasicBlock/ResNet layout, ref torchvision.models.resnet)."""
+
+    class BasicBlock(torch.nn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.conv1 = torch.nn.Conv2d(cin, cout, 3, stride, 1,
+                                         bias=False)
+            self.bn1 = torch.nn.BatchNorm2d(cout)
+            self.relu = torch.nn.ReLU(inplace=True)
+            self.conv2 = torch.nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = torch.nn.BatchNorm2d(cout)
+            self.down = None
+            if stride != 1 or cin != cout:
+                self.down = torch.nn.Sequential(
+                    torch.nn.Conv2d(cin, cout, 1, stride, bias=False),
+                    torch.nn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            identity = x if self.down is None else self.down(x)
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.bn2(self.conv2(out))
+            out += identity
+            return self.relu(out)
+
+    class ResNet18(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = torch.nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = torch.nn.BatchNorm2d(64)
+            self.relu = torch.nn.ReLU(inplace=True)
+            self.maxpool = torch.nn.MaxPool2d(3, 2, 1)
+            layers = []
+            cin = 64
+            for cout, stride in ((64, 1), (64, 1), (128, 2), (128, 1),
+                                 (256, 2), (256, 1), (512, 2), (512, 1)):
+                layers.append(BasicBlock(cin, cout, stride))
+                cin = cout
+            self.layers = torch.nn.Sequential(*layers)
+            self.avgpool = torch.nn.AdaptiveAvgPool2d((1, 1))
+            self.fc = torch.nn.Linear(512, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x = self.layers(x)
+            x = self.avgpool(x)
+            x = torch.flatten(x, 1)
+            return self.fc(x)
+
+    return ResNet18()
+
+
+class TestResNet18:
+
+    def test_resnet18_converts_and_matches_eager(self):
+        m = _make_resnet18().eval()
+        _compare(m, torch.randn(2, 3, 32, 32), rtol=5e-3)
+
+    def test_resnet18_trains_on_mesh(self):
+        """Converted resnet18 trains end-to-end under @parallelize on the
+        8-device mesh (VERDICT r2 next #9).  BatchNorm uses frozen
+        running stats (eval-mode functionalization); conv/fc/affine
+        weights train."""
+        import optax
+
+        m = _make_resnet18(num_classes=10)
+        fn, params, buffers = functionalize(m, split_buffers=True)
+        set_mode("dist")
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.randn(16, 3, 32, 32), jnp.float32)
+        y = jnp.asarray(rs.randint(0, 10, (16,)), jnp.int32)
+        tx = optax.adam(3e-3)
+        opt_state = tx.init(params)
+
+        @alpa_tpu.parallelize(method=alpa_tpu.DataParallel(),
+                              batch_argnums=(2, 3),
+                              donate_argnums=(0, 1))
+        def step(params, opt_state, x, y):
+
+            def loss_fn(p):
+                logits = fn({**p, **buffers}, x)
+                onehot = jax.nn.one_hot(y, 10)
+                return -(jax.nn.log_softmax(logits) * onehot).sum(-1).mean()
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(params)
+            updates, opt_state2 = tx.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state2, loss
+
+        losses = []
+        for _ in range(15):
+            params, opt_state, loss = step(params, opt_state, x, y)
+            losses.append(float(loss))
+        # 16 random samples, 10 classes: adam should be well on the way
+        # to memorizing them
+        assert losses[-1] < losses[0] * 0.7, losses
 
 
 class TestOptimAndTrainer:
