@@ -115,6 +115,53 @@ def broadcast_from_coordinator(pytree):
 # dedicated interconnect transfer library).
 
 
+def psum_work_dtype(dtype) -> "np.dtype":
+    """psum-safe working dtype: widen sub-word types; keep word-size and
+    wider types exact (an int64/float64 array can only exist with x64
+    enabled, in which case psum carries it losslessly)."""
+    import numpy as np
+    dtype = np.dtype(dtype)
+    if dtype == np.bool_:
+        return np.dtype(np.int32)
+    if dtype.itemsize < 4:
+        return (np.dtype(np.int32) if dtype.kind in "iu"
+                else np.dtype(np.float32))
+    return dtype
+
+
+def sum_across_processes(canvas: "np.ndarray") -> "np.ndarray":
+    """Element-wise sum of every process's host ``canvas``, materialized
+    identically on all processes — ONE global-device collective.
+
+    COLLECTIVE: every process must call it with a same-shape/dtype canvas
+    in the same order.  Each process's canvas rides in its first local
+    device's slot of a global stack (other local slots carry zeros), one
+    jitted sum reduces over the process axis.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    shape, work = canvas.shape, canvas.dtype
+    devs = jax.devices()
+    gmesh = Mesh(np.array(devs), ("p",))
+    slot_sh = NamedSharding(gmesh, P("p"))
+    # make_array skips the cross-process value-consistency check that
+    # device_put(host, ...) enforces
+    first_local = min(jax.local_devices(), key=lambda d: d.id)
+    zeros = np.zeros((1,) + tuple(shape), work)
+    shards = [
+        jax.device_put(
+            jnp.asarray(canvas[None] if d == first_local else zeros), d)
+        for d in jax.local_devices()
+    ]
+    stacked = jax.make_array_from_single_device_arrays(
+        (len(devs),) + tuple(shape), slot_sh, shards, dtype=work)
+    summed = jax.jit(lambda a: a.sum(0),
+                     out_shardings=NamedSharding(gmesh, P()))(stacked)
+    return np.asarray(summed.addressable_shards[0].data)
+
+
 def host_gather(arr) -> "np.ndarray":
     """Full value of a (possibly non-fully-addressable) global jax.Array,
     materialized identically on every process.
@@ -133,45 +180,13 @@ def host_gather(arr) -> "np.ndarray":
     if jax.process_count() <= 1:
         return np.asarray(arr)
 
-    import jax.numpy as jnp
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-    shape = arr.shape
     dtype = np.dtype(arr.dtype)
-    # psum-safe working dtype: widen sub-word types; keep word-size and
-    # wider types exact (an int64/float64 array can only exist with x64
-    # enabled, in which case psum carries it losslessly)
-    if dtype == np.bool_:
-        work = np.dtype(np.int32)
-    elif dtype.itemsize < 4:
-        work = (np.dtype(np.int32) if dtype.kind in "iu"
-                else np.dtype(np.float32))
-    else:
-        work = dtype
-
-    canvas = np.zeros(shape, work)
+    work = psum_work_dtype(dtype)
+    canvas = np.zeros(arr.shape, work)
     for s in arr.addressable_shards:
         if s.replica_id == 0:
             canvas[s.index] = np.asarray(s.data).astype(work)
-
-    devs = jax.devices()
-    gmesh = Mesh(np.array(devs), ("p",))
-    slot_sh = NamedSharding(gmesh, P("p"))
-    # this process's canvas rides in its first local device's slot; its
-    # other local slots carry zeros (make_array skips the cross-process
-    # value-consistency check that device_put(host, ...) enforces)
-    first_local = min(jax.local_devices(), key=lambda d: d.id)
-    zeros = np.zeros((1,) + tuple(shape), work)
-    shards = [
-        jax.device_put(
-            jnp.asarray(canvas[None] if d == first_local else zeros), d)
-        for d in jax.local_devices()
-    ]
-    stacked = jax.make_array_from_single_device_arrays(
-        (len(devs),) + tuple(shape), slot_sh, shards, dtype=work)
-    summed = jax.jit(lambda a: a.sum(0),
-                     out_shardings=NamedSharding(gmesh, P()))(stacked)
-    full = np.asarray(summed.addressable_shards[0].data)
+    full = sum_across_processes(canvas)
     if dtype == np.bool_:
         return full != 0
     return full.astype(dtype)

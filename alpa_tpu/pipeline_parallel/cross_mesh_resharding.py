@@ -323,6 +323,93 @@ class ReshardingTask:
                                       "source layout diverged from plan")
         return self._run_planned(src_array, broadcast=(mode == "broadcast"))
 
+    def run_multiprocess(self, src_array):
+        """Cross-PROCESS tiled execution (multi-controller): only the
+        packed unique planned tiles cross the process boundary — one
+        global-device collective over a buffer of exactly the plan's
+        bytes — and each process assembles its local destination shards
+        from the packed buffer.
+
+        This is the multi-controller analog of the reference's per-tile
+        NCCL send/recv (ref SymbolicReshardingTask:418): DCN traffic is
+        proportional to the PLANNED tiles instead of the full-array
+        gather that ``put_global`` pays.
+
+        COLLECTIVE: all processes execute the same instruction stream, so
+        they reach this call in the same order with the same spec.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        from alpa_tpu.distributed import (psum_work_dtype, put_global,
+                                          sum_across_processes)
+
+        spec = self.spec
+        if not spec.requests:
+            self.last_report = ExecutionReport(mode="device_put")
+            return put_global(src_array, self.dst_sharding)
+
+        dtype = np.dtype(src_array.dtype)
+        work = psum_work_dtype(dtype)
+        report = ExecutionReport(mode="tiled")
+
+        # unique planned tiles, packed in deterministic plan order
+        order: List[TileSlice] = []
+        offsets: Dict[Tuple, int] = {}
+        total = 0
+        for req in spec.requests:
+            for ts in req.srcs:
+                if ts.tile.slices in offsets:
+                    continue
+                offsets[ts.tile.slices] = total
+                total += ts.tile.size
+                order.append(ts)
+
+        # cross-process leg: each tile is painted by the process owning
+        # its (load-balanced, unique) planned source shard
+        local_src = {s.device.id: np.asarray(s.data)
+                     for s in src_array.addressable_shards}
+        canvas = np.zeros(total, work)
+        for ts in order:
+            dev_id = spec.src_device_ids[ts.src_shard_index]
+            shard = local_src.get(dev_id)
+            if shard is not None:
+                piece = shard[tuple(slice(a, b)
+                                    for a, b in ts.offset_in_src)]
+                off = offsets[ts.tile.slices]
+                canvas[off:off + ts.tile.size] = \
+                    piece.ravel().astype(work)
+        packed = sum_across_processes(canvas)
+        report.cross_mesh_bytes = float(total) * dtype.itemsize
+        report.n_tiles = len(order)
+
+        # local assembly: every locally-addressable destination shard
+        # fills its full tile from the intersecting packed tiles
+        shard_of_dev = {d: i for i, d in enumerate(spec.dst_device_ids)}
+        arrs = []
+        for dev in sorted(self.dst_sharding.addressable_devices,
+                          key=lambda d: d.id):
+            full_tile = spec.dst_tiles[shard_of_dev[dev.id]]
+            buf = np.zeros(full_tile.shape, work)
+            for ts in order:
+                inter = ts.tile.intersect(full_tile)
+                if inter is None:
+                    continue
+                off = offsets[ts.tile.slices]
+                tile_arr = packed[off:off + ts.tile.size].reshape(
+                    ts.tile.shape)
+                src_idx = tuple(slice(a, b)
+                                for a, b in inter.offset_in(ts.tile))
+                dst_idx = tuple(slice(a, b)
+                                for a, b in inter.offset_in(full_tile))
+                buf[dst_idx] = tile_arr[src_idx]
+            arrs.append(jax.device_put(jnp.asarray(buf.astype(dtype)),
+                                       dev))
+        out = jax.make_array_from_single_device_arrays(
+            spec.shape, self.dst_sharding, arrs, dtype=dtype)
+        self.last_report = report
+        return out
+
     def _fallback(self, src_array, why: str):
         import jax
         global _warned_fallback

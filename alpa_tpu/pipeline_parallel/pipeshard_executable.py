@@ -566,9 +566,12 @@ class PipeshardDriverExecutable:
                 "global_config.resharding_execution must be 'device_put' "
                 f"or 'planned', got {exec_mode!r}")
         multiprocess = jax.process_count() > 1
+        # multiprocess + "planned": cross-process RESHARD instructions
+        # drive the tile plan via ReshardingTask.run_multiprocess (packed
+        # tiles cross the boundary, not a full-array gather); everything
+        # else stays host-mediated put_global
+        mp_planned = multiprocess and exec_mode == "planned"
         if multiprocess:
-            # cross-process placement/transfers are host-mediated; the
-            # planned executor is a single-controller validation mode
             from alpa_tpu.distributed import host_gather, put_global
             _put = put_global
             exec_mode = "device_put"
@@ -661,8 +664,21 @@ class PipeshardDriverExecutable:
                     tracer.log("RUN", inst.info)
             elif inst.opcode == PipelineInstType.RESHARD:
                 val = env[inst.var_key][inst.src_mesh]
-                if (exec_mode == "planned" and inst.src_mesh != inst.dst_mesh
-                        and inst.plan is not None):
+                if (mp_planned and inst.src_mesh != inst.dst_mesh and
+                        inst.plan is not None):
+                    if inst.task is None:
+                        from alpa_tpu.pipeline_parallel. \
+                            cross_mesh_resharding import ReshardingTask
+                        inst.task = ReshardingTask(inst.plan,
+                                                   inst.dst_sharding)
+                    env[inst.var_key][inst.dst_mesh] = \
+                        inst.task.run_multiprocess(val)
+                    rep = inst.task.last_report
+                    self._executed_resharding_bytes += rep.cross_mesh_bytes
+                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
+                elif (exec_mode == "planned" and
+                      inst.src_mesh != inst.dst_mesh and
+                      inst.plan is not None):
                     # Drive the tile plan literally (per-tile routed
                     # transfers; send_recv or broadcast leg choice from
                     # global_config.resharding_mode, ref :418/:935).
