@@ -250,13 +250,20 @@ _warned_fallback = False
 class ExecutionReport:
     """Bytes actually moved by one ``ReshardingTask.run`` call.
 
-    ``cross_mesh_bytes`` is the inter-mesh traffic (the DCN-class hop the
-    planner minimizes); ``intra_mesh_bytes`` is destination-internal
-    movement (the ICI-class all-gather/broadcast leg).  Tests assert
-    ``cross_mesh_bytes == spec.transfer_bytes``."""
+    ``cross_mesh_bytes`` is the inter-mesh traffic in planned (payload
+    dtype) bytes — the DCN-class hop the planner minimizes;
+    ``intra_mesh_bytes`` is destination-internal movement (the ICI-class
+    all-gather/broadcast leg).  Tests assert ``cross_mesh_bytes ==
+    spec.transfer_bytes``.  ``wire_bytes`` is the planned bytes widened to
+    the psum work dtype the multiprocess leg actually packs tiles in
+    (bf16/fp16 -> f32, bool -> i32) — up to 4x the planned bytes for
+    sub-word payloads.  It is per-process payload size, not a total-DCN
+    measurement (the collective also carries each non-owner process's
+    zero slots), and only ``run_multiprocess`` sets it."""
     mode: str = "device_put"
     cross_mesh_bytes: float = 0.0
     intra_mesh_bytes: float = 0.0
+    wire_bytes: float = 0.0
     n_tiles: int = 0
 
 
@@ -381,6 +388,7 @@ class ReshardingTask:
                     piece.ravel().astype(work)
         packed = sum_across_processes(canvas)
         report.cross_mesh_bytes = float(total) * dtype.itemsize
+        report.wire_bytes = float(total) * np.dtype(work).itemsize
         report.n_tiles = len(order)
 
         # local assembly: every locally-addressable destination shard

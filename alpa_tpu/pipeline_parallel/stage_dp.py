@@ -222,9 +222,22 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget, mode=0):
 ########################################
 
 
-def compute_cost_cache_key(layer_comps, choices, profiling_mode) -> str:
+def compute_cost_cache_key(layer_comps, choices, profiling_mode,
+                           with_memory=False, calibration=None,
+                           db_file=None, measured_limit=None) -> str:
     """Content key: the layers' jaxprs + the submesh search space + the
-    profiling mode.  Any change invalidates the cache."""
+    profiling mode + whether memory tensors were computed + the effective
+    calibration.  Any change invalidates the cache.
+
+    ``with_memory`` matters because the stored mem_param/mem_act tensors
+    are all-zero when no memory budget was set at write time; reusing them
+    under a budget would make the DP's feasibility check vacuous.
+    ``calibration``/``db_file`` matter because the cost tensor bakes in the
+    profiling DB's fit — switching DBs or TPU generations must miss (an
+    in-place re-profile changes the fitted dot_points/collective_ab and so
+    the key).  ``measured_limit`` matters in measured mode: a wider
+    refinement sweep produces a different tensor.
+    """
     import hashlib
     h = hashlib.sha256()
     for c in layer_comps:
@@ -232,6 +245,13 @@ def compute_cost_cache_key(layer_comps, choices, profiling_mode) -> str:
                      else c).encode())
     h.update(repr(list(choices)).encode())
     h.update(profiling_mode.encode())
+    h.update(b"mem" if with_memory else b"nomem")
+    h.update(repr(db_file).encode())
+    if profiling_mode == "measured":
+        h.update(repr(measured_limit).encode())
+    if calibration is not None:
+        h.update(repr(sorted(calibration.dot_points)).encode())
+        h.update(repr(sorted(calibration.collective_ab.items())).encode())
     return h.hexdigest()[:16]
 
 
@@ -320,6 +340,7 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
         (L * L * M <= 256)
     mem_budget = float(
         getattr(stage_option, "memory_budget_per_device", None) or 0.0)
+    measured_limit = getattr(stage_option, "measured_candidates_limit", 16)
 
     # Disk cache of the cost tensors (ref compute-cost-<time>.npy,
     # stage_profiling.py:53), keyed by the model + search-space content so
@@ -330,7 +351,9 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
     if cache_file:
         cache_key = compute_cost_cache_key(
             layer_comps, choices,
-            getattr(stage_option, "profiling_mode", "cost_model"))
+            getattr(stage_option, "profiling_mode", "cost_model"),
+            with_memory=mem_budget > 0, calibration=cal, db_file=db_file,
+            measured_limit=measured_limit)
         cached = load_compute_cost_cache(cache_file, cache_key, (L, L, M))
         if cached is not None:
             costs, mem_param, mem_act = cached
@@ -381,7 +404,7 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             from alpa_tpu.mesh_profiling import refine_costs_measured
             n = refine_costs_measured(
                 costs, layer_comps, sizes, auto_sharding_option,
-                limit=getattr(stage_option, "measured_candidates_limit", 16),
+                limit=measured_limit,
                 compile_workers=getattr(stage_option,
                                         "measured_compile_workers", 4))
             logger.info("measured stage profiling refined %d candidates", n)

@@ -13,6 +13,7 @@ dropout (eval = identity).
 """
 import logging
 import operator
+import warnings
 from typing import Any, Callable, Dict
 
 import jax
@@ -225,11 +226,24 @@ def _group_norm(x, num_groups, w=None, b=None, eps=1e-5):
 
 def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
                 training=False, momentum=0.1, eps=1e-5):
-    # eval-mode semantics: normalize with running statistics (the
-    # functionalized frontend traces modules in eval mode)
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    y = (x - running_mean.reshape(shape)) / jnp.sqrt(
-        running_var.reshape(shape) + eps)
+    if training:
+        # batch statistics, matching torch train-mode numerics.  The
+        # running-stat update is a side effect the functional trace cannot
+        # express, so running_mean/var stay frozen — warn when there are
+        # stats being left behind (track_running_stats=False has none).
+        if running_mean is not None:
+            warnings.warn(
+                "F.batch_norm traced with training=True: batch statistics "
+                "are used, but running-stat updates (momentum) are dropped "
+                "by the functional trace", stacklevel=2)
+        axes = (0,) + tuple(range(2, x.ndim))
+        mean = x.mean(axes)
+        var = ((x - mean.reshape(shape))**2).mean(axes)
+    else:
+        # eval-mode semantics: normalize with running statistics
+        mean, var = running_mean, running_var
+    y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
     if weight is not None:
         y = y * weight.reshape(shape)
     if bias is not None:
@@ -243,8 +257,10 @@ def _scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * scale
     if is_causal:
+        # torch's is_causal uses a top-left aligned mask (tril diagonal 0)
+        # even when query and key lengths differ
         lq, lk = scores.shape[-2], scores.shape[-1]
-        causal = jnp.tril(jnp.ones((lq, lk), bool), lk - lq)
+        causal = jnp.tril(jnp.ones((lq, lk), bool))
         scores = jnp.where(causal, scores, -jnp.inf)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:
@@ -408,12 +424,16 @@ def _convert_module(mod, params_prefix: str):
         return lambda p, x: _max_pool2d(x, ks, st, pd)
     if isinstance(mod, (torch.nn.BatchNorm1d, torch.nn.BatchNorm2d,
                         torch.nn.BatchNorm3d)):
+        # torch semantics: batch statistics in train mode AND whenever
+        # running stats aren't tracked (running_mean is None even in eval)
         eps = mod.eps
+        use_batch_stats = mod.training or not mod.track_running_stats
         def f(p, x):
-            return _batch_norm(x, p[f"{params_prefix}running_mean"],
-                               p[f"{params_prefix}running_var"],
+            return _batch_norm(x, p.get(f"{params_prefix}running_mean"),
+                               p.get(f"{params_prefix}running_var"),
                                p.get(f"{params_prefix}weight"),
-                               p.get(f"{params_prefix}bias"), eps=eps)
+                               p.get(f"{params_prefix}bias"),
+                               training=use_batch_stats, eps=eps)
         return f
     if isinstance(mod, torch.nn.GroupNorm):
         ng, eps = mod.num_groups, mod.eps
@@ -571,8 +591,11 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
 def functionalize(module, concrete_args=None, split_buffers=False):
     """torch.nn.Module -> (jax_fn, params_dict).
 
-    jax_fn(params, *jax_inputs) reproduces module.forward in eval mode
-    (ref: the functionalized nn of alpa/torch/nn/).
+    jax_fn(params, *jax_inputs) reproduces module.forward in the module's
+    CURRENT train/eval mode (ref: the functionalized nn of alpa/torch/nn/).
+    Train-mode tracing warns: BatchNorm uses batch statistics (matching
+    torch), but the running-stat update and dropout randomness are side
+    effects the functional trace drops.
 
     With ``split_buffers=True`` returns (jax_fn, trainable, buffers):
     ``trainable`` holds entries backed by torch Parameters, ``buffers``
@@ -584,7 +607,12 @@ def functionalize(module, concrete_args=None, split_buffers=False):
     import torch
     import torch.fx
 
-    module = module.eval()
+    if module.training:
+        warnings.warn(
+            "functionalize: tracing a train-mode module — BatchNorm uses "
+            "batch statistics but running-stat updates and dropout are "
+            "dropped by the functional trace; call .eval() first for "
+            "eval semantics", stacklevel=2)
     gm = torch.fx.symbolic_trace(module, concrete_args=concrete_args)
     params = {
         k: torch_to_jax_array(v)

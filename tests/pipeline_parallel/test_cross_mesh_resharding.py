@@ -128,6 +128,25 @@ class TestPlannedExecution:
         assert task.last_report.cross_mesh_bytes == spec.transfer_bytes
         assert task.last_report.intra_mesh_bytes == 0
 
+    def test_multiprocess_wire_bytes_accounting(self):
+        """run_multiprocess packs tiles in a widened psum work dtype;
+        wire_bytes must reflect that (2x planned for bf16), while
+        cross_mesh_bytes stays planned-payload bytes (ADVICE r3)."""
+        src_mesh, dst_mesh = self._src_dst()
+        src = NamedSharding(src_mesh, P("x"))
+        dst = NamedSharding(dst_mesh, P(None, "y"))
+        x = jax.device_put(jnp.arange(64.0, dtype=jnp.bfloat16)
+                           .reshape(8, 8), src)
+        spec = plan_resharding((8, 8), x.dtype.itemsize, src, dst,
+                               allow_allgather_rewrite=False)
+        task = ReshardingTask(spec, dst)
+        y = task.run_multiprocess(x)
+        np.testing.assert_array_equal(np.asarray(y, np.float32),
+                                      np.arange(64.0).reshape(8, 8))
+        rep = task.last_report
+        assert rep.cross_mesh_bytes == spec.transfer_bytes
+        assert rep.wire_bytes == 2 * rep.cross_mesh_bytes
+
     def test_allgather_rewrite_executes_fewer_cross_bytes(self):
         src_mesh, dst_mesh = self._src_dst()
         src = NamedSharding(src_mesh, P("x"))

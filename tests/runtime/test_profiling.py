@@ -158,6 +158,32 @@ class TestMeasuredStageProfiling:
         assert load_compute_cost_cache(path, "otherkey", (3, 3, 2)) is None
         assert load_compute_cost_cache(path, key, (4, 4, 2)) is None
 
+    def test_compute_cost_cache_key_sensitivity(self):
+        """The key must change when the memory budget becomes active, the
+        DB file changes, or the calibration content changes (ADVICE r3):
+        a no-budget cache stores all-zero memory tensors and must not be
+        reused under a budget."""
+        from alpa_tpu.mesh_profiling import CalibratedCostModel
+        from alpa_tpu.pipeline_parallel.stage_dp import (
+            compute_cost_cache_key)
+
+        comps, choices = [], [(1, 1), (1, 2)]
+        base = compute_cost_cache_key(comps, choices, "cost_model")
+        assert compute_cost_cache_key(comps, choices, "cost_model") == base
+        assert compute_cost_cache_key(
+            comps, choices, "cost_model", with_memory=True) != base
+        assert compute_cost_cache_key(
+            comps, choices, "cost_model", db_file="other.json") != base
+        cal_a = CalibratedCostModel([(1e9, 1e-12)], {"all_reduce": (1e-5,
+                                                                    1e-10)})
+        cal_b = CalibratedCostModel([(1e9, 2e-12)], {"all_reduce": (1e-5,
+                                                                    1e-10)})
+        ka = compute_cost_cache_key(comps, choices, "cost_model",
+                                    calibration=cal_a)
+        kb = compute_cost_cache_key(comps, choices, "cost_model",
+                                    calibration=cal_b)
+        assert ka != kb and ka != base
+
     def test_cached_compute_cost_end_to_end(self, tmp_path):
         """Full pipeshard compile with cached_compute_cost set: first run
         writes the cache, second run (fresh executable) reads it and

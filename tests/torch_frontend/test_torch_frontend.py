@@ -118,6 +118,15 @@ class TestConversion:
             m[1].running_var += torch.rand(16)
         _compare(m, torch.randn(4, 8))
 
+    def test_batch_norm_no_tracked_stats(self):
+        """track_running_stats=False modules use batch statistics even in
+        eval mode (torch semantics) and must not KeyError on the missing
+        running_mean/var buffers."""
+        m = torch.nn.Sequential(
+            torch.nn.Linear(8, 16),
+            torch.nn.BatchNorm1d(16, track_running_stats=False)).eval()
+        _compare(m, torch.randn(4, 8))
+
     def test_multihead_attention(self):
         for batch_first in (True, False):
             m = torch.nn.MultiheadAttention(16, 4,
@@ -142,6 +151,41 @@ class TestConversion:
         q = torch.randn(2, 4, 8, 16)
         _compare(Net(), q, torch.randn(2, 4, 8, 16),
                  torch.randn(2, 4, 8, 16))
+
+    def test_sdpa_causal_cross_length(self):
+        """torch's is_causal is TOP-LEFT aligned when lq != lk (ADVICE r3)."""
+        from alpa_tpu.torch_frontend.converter import \
+            _scaled_dot_product_attention
+        q = torch.randn(2, 4, 5, 16)
+        k = torch.randn(2, 4, 9, 16)
+        v = torch.randn(2, 4, 9, 16)
+        with torch.no_grad():
+            expected = torch.nn.functional.scaled_dot_product_attention(
+                q, k, v, is_causal=True).numpy()
+        got = np.asarray(_scaled_dot_product_attention(
+            jnp.asarray(q.numpy()), jnp.asarray(k.numpy()),
+            jnp.asarray(v.numpy()), is_causal=True))
+        np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+    def test_batch_norm_training_uses_batch_stats(self):
+        """training=True normalizes with batch statistics and warns that
+        running-stat updates are dropped (ADVICE r3)."""
+        import warnings as _warnings
+        from alpa_tpu.torch_frontend.converter import _batch_norm
+        x = torch.randn(8, 6)
+        rm, rv = torch.randn(6) * 0.1, torch.rand(6) + 0.5
+        w, b = torch.randn(6), torch.randn(6)
+        with torch.no_grad():
+            expected = torch.nn.functional.batch_norm(
+                x, rm.clone(), rv.clone(), w, b, training=True).numpy()
+        with _warnings.catch_warnings(record=True) as rec:
+            _warnings.simplefilter("always")
+            got = np.asarray(_batch_norm(
+                jnp.asarray(x.numpy()), jnp.asarray(rm.numpy()),
+                jnp.asarray(rv.numpy()), jnp.asarray(w.numpy()),
+                jnp.asarray(b.numpy()), training=True))
+        assert any("training=True" in str(r.message) for r in rec)
+        np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
 
     def test_unmapped_op_clear_error(self):
 
