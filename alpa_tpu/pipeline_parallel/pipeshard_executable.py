@@ -640,9 +640,16 @@ class PipeshardDriverExecutable:
             for v, buf in zip(vs, bufs):
                 env.setdefault((v, -1), {})[mesh_id] = buf
 
-        # interpret
+        # interpret.  Per-opcode wall time is recorded so the driver-side
+        # dispatch overhead (SURVEY §7 hard part 5: does a single Python
+        # loop keep up with the meshes?) is measurable: on an async
+        # backend RUN returns as soon as the work is enqueued, so
+        # ``last_dispatch_stats`` bounds the per-instruction driver cost.
         collect = global_config.collect_trace
+        stats = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}
+        loop_tic = time.perf_counter()
         for inst in self.instructions:
+            inst_tic = time.perf_counter()
             if inst.opcode == PipelineInstType.RUN:
                 exec_ = inst.executable
                 args = [env[k][inst.dst_mesh] for k in inst.input_keys]
@@ -704,6 +711,18 @@ class PipeshardDriverExecutable:
                     d = env.get((v, i))
                     if d is not None:
                         d.pop(m, None)
+            s = stats[inst.opcode.name]
+            s[0] += 1
+            s[1] += time.perf_counter() - inst_tic
+        loop_s = time.perf_counter() - loop_tic
+        n_inst = max(1, len(self.instructions))
+        self.last_dispatch_stats = {
+            "n_instructions": len(self.instructions),
+            "loop_s": loop_s,
+            "per_inst_us": loop_s / n_inst * 1e6,
+            "by_opcode": {k: {"n": n, "s": t}
+                          for k, (n, t) in stats.items()},
+        }
 
         # collect outputs
         outs = []

@@ -224,7 +224,8 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget, mode=0):
 
 def compute_cost_cache_key(layer_comps, choices, profiling_mode,
                            with_memory=False, calibration=None,
-                           db_file=None, measured_limit=None) -> str:
+                           db_file=None, measured_limit=None,
+                           exact_ilp=None, sharding_option=None) -> str:
     """Content key: the layers' jaxprs + the submesh search space + the
     profiling mode + whether memory tensors were computed + the effective
     calibration.  Any change invalidates the cache.
@@ -236,7 +237,9 @@ def compute_cost_cache_key(layer_comps, choices, profiling_mode,
     profiling DB's fit — switching DBs or TPU generations must miss (an
     in-place re-profile changes the fitted dot_points/collective_ab and so
     the key).  ``measured_limit`` matters in measured mode: a wider
-    refinement sweep produces a different tensor.
+    refinement sweep produces a different tensor.  ``exact_ilp`` (merged
+    -span ILP vs additive prefix sums) and ``sharding_option`` (feeds
+    every per-span ILP solve) also shape the tensor and must miss.
     """
     import hashlib
     h = hashlib.sha256()
@@ -249,6 +252,8 @@ def compute_cost_cache_key(layer_comps, choices, profiling_mode,
     h.update(repr(db_file).encode())
     if profiling_mode == "measured":
         h.update(repr(measured_limit).encode())
+    h.update(repr(exact_ilp).encode())
+    h.update(repr(sharding_option).encode())
     if calibration is not None:
         h.update(repr(sorted(calibration.dot_points)).encode())
         h.update(repr(sorted(calibration.collective_ab.items())).encode())
@@ -353,7 +358,8 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             layer_comps, choices,
             getattr(stage_option, "profiling_mode", "cost_model"),
             with_memory=mem_budget > 0, calibration=cal, db_file=db_file,
-            measured_limit=measured_limit)
+            measured_limit=measured_limit, exact_ilp=exact_ilp,
+            sharding_option=auto_sharding_option)
         cached = load_compute_cost_cache(cache_file, cache_key, (L, L, M))
         if cached is not None:
             costs, mem_param, mem_act = cached

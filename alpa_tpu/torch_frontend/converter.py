@@ -228,6 +228,12 @@ def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
                 training=False, momentum=0.1, eps=1e-5):
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
     if training:
+        n_per_channel = x.size // x.shape[1] if x.ndim > 1 else x.size
+        if n_per_channel <= 1:
+            # torch raises here too: var==0 would silently train on bias
+            raise ValueError(
+                "Expected more than 1 value per channel when training, "
+                f"got input size {tuple(x.shape)}")
         # batch statistics, matching torch train-mode numerics.  The
         # running-stat update is a side effect the functional trace cannot
         # express, so running_mean/var stay frozen — warn when there are

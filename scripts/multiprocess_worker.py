@@ -1,32 +1,145 @@
-"""Worker for the 2-process jax.distributed CPU test.
+"""Worker for the multi-process jax.distributed CPU tests.
 
-Each process pins a 4-device virtual CPU backend, joins the coordinator,
-and drives alpa_tpu over the resulting 8-device global mesh — proving
-the single-controller design survives a process boundary (VERDICT r1
+Each process pins a virtual CPU backend, joins the coordinator, and
+drives alpa_tpu over the resulting global mesh — proving the
+single-controller design survives process boundaries (VERDICT r1
 next#6; analog of the reference's Ray-emulated multi-host tests,
 ref tests/pipeline_parallel/ + alpa/device_mesh.py:979).
 
-Run (same on both):  python multiprocess_worker.py <process_id> <nproc> <port>
+Run (same on all):
+  python multiprocess_worker.py <process_id> <nproc> <port> [mode]
+
+mode "basic" (default, 4 devices/proc): ShardParallel + 2-stage uniform
+pipeshard with serial oracles.
+mode "auto" (2 devices/proc, meant for 4 processes): AUTO stage
+construction (OSDI'22 DP), planned/tiled cross-process resharding
+(packed-tile collective, not full-array gather), and a measured
+per-instruction dispatch latency (SURVEY §7 hard part 5), printed as
+``dispatch_stats {...}``.
+
 Prints ``MP_OK <process_id>`` on success.
 """
+import json
 import os
 import sys
+
+
+def _auto_mode(nproc, process_id):
+    """4-process proof: auto stage construction + planned (packed-tile)
+    cross-process resharding + dispatch-latency measurement."""
+    import time
+
+    import jax
+
+    import alpa_tpu
+    import alpa_tpu.distributed as dist
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        ManualLayerOption)
+    from alpa_tpu.pipeline_parallel.stage_construction import AutoStageOption
+    from alpa_tpu.testing import (assert_allclose,
+                                  create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    alpa_tpu.init(cluster="distributed")
+    # cross-process RESHARD instructions drive the tile plan via the
+    # packed-tile collective instead of a full-array host gather
+    global_config.resharding_execution = "planned"
+
+    method = alpa_tpu.PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=ManualLayerOption(),
+        stage_option=AutoStageOption())
+    state_p, batch = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    state_s, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    pstep = get_mlp_train_step(method, use_value_and_grad=True)
+    serial = get_mlp_train_step(None)
+
+    state_p, loss_p = pstep(state_p, batch)
+    state_s, loss_s = serial(state_s, batch)
+    lp = float(dist.host_gather(loss_p))
+    assert_allclose(float(loss_s), lp, 2e-3, 2e-3)
+    params_p = jax.tree_util.tree_map(dist.host_gather, state_p.params)
+    assert_allclose(jax.device_get(state_s.params), params_p, 2e-3, 2e-3)
+
+    ex = pstep.get_last_executable()
+    n_meshes = ex.num_meshes
+    print(f"auto pipeshard ok: loss {lp:.6f} meshes {n_meshes}", flush=True)
+
+    # steady-state dispatch latency: time a few steps after warmup and
+    # report the Python-loop overhead per instruction
+    for _ in range(2):
+        state_p, loss_p = pstep(state_p, batch)
+    tic = time.perf_counter()
+    n_iter = 3
+    for _ in range(n_iter):
+        state_p, loss_p = pstep(state_p, batch)
+    dist.host_gather(loss_p)
+    step_s = (time.perf_counter() - tic) / n_iter
+    stats = dict(ex.last_dispatch_stats)
+    stats["step_s"] = step_s
+    stats["executed_cross_mesh_bytes"] = ex._executed_resharding_bytes
+    print("dispatch_stats " + json.dumps(stats), flush=True)
+    if n_meshes > 1:
+        assert ex._executed_resharding_bytes > 0, \
+            "multi-mesh step must move cross-mesh bytes"
+
+    # ---- 4 uniform stages: one stage mesh PER PROCESS (the pod-dispatch
+    # shape), cross-process boundaries driven by the packed-tile plan ----
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+
+    from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+
+    method4 = alpa_tpu.PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=AutoLayerOption(layer_num=nproc),
+        stage_option=UniformStageOption(num_stages=nproc))
+    state_4, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    state_4s, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    pstep4 = get_mlp_train_step(method4, use_value_and_grad=True)
+    state_4, loss_4 = pstep4(state_4, batch)
+    state_4s, loss_4s = serial(state_4s, batch)
+    l4 = float(dist.host_gather(loss_4))
+    assert_allclose(float(loss_4s), l4, 2e-3, 2e-3)
+    ex4 = pstep4.get_last_executable()
+    assert ex4.num_meshes == nproc, ex4.num_meshes
+    st4 = dict(ex4.last_dispatch_stats)
+    st4["executed_cross_mesh_bytes"] = ex4._executed_resharding_bytes
+    assert st4["by_opcode"]["RESHARD"]["n"] > 0, st4
+    assert ex4._executed_resharding_bytes > 0, \
+        "per-process stages must move cross-mesh bytes"
+    print("dispatch_stats4 " + json.dumps(st4), flush=True)
+    print(f"uniform4 ok: loss {l4:.6f} meshes {ex4.num_meshes}", flush=True)
+
+    dist.sync_global_devices("done")
+    print(f"MP_OK {process_id}", flush=True)
 
 
 def main():
     process_id = int(sys.argv[1])
     nproc = int(sys.argv[2])
     port = sys.argv[3]
+    mode = sys.argv[4] if len(sys.argv) > 4 else "basic"
 
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_num_cpu_devices", 2 if mode == "auto" else 4)
     import alpa_tpu.distributed as dist
     dist.initialize(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=nproc, process_id=process_id)
+    ndev_local = 2 if mode == "auto" else 4
     assert jax.process_count() == nproc, jax.process_count()
-    assert jax.device_count() == 4 * nproc, jax.devices()
-    assert jax.local_device_count() == 4
+    assert jax.device_count() == ndev_local * nproc, jax.devices()
+    assert jax.local_device_count() == ndev_local
+
+    if mode == "auto":
+        _auto_mode(nproc, process_id)
+        return
 
     import jax.numpy as jnp
     import numpy as np

@@ -25,7 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_runtime():
+def _run_workers(nproc, mode=None, timeout=540):
     port = _free_port()
     env = dict(os.environ)
     env.update({
@@ -33,16 +33,17 @@ def test_two_process_runtime():
         "XLA_FLAGS": "",
         "PYTHONPATH": REPO_ROOT,
     })
+    args = [str(port)] + ([mode] if mode else [])
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, str(i), "2", str(port)],
+            [sys.executable, WORKER, str(i), str(nproc)] + args,
             env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True) for i in range(2)
+            text=True) for i in range(nproc)
     ]
     outs = []
     for i, p in enumerate(procs):
         try:
-            out, err = p.communicate(timeout=540)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -52,8 +53,42 @@ def test_two_process_runtime():
         assert rc == 0, (f"worker {i} rc={rc}\n--- stdout:\n{out[-2000:]}"
                          f"\n--- stderr:\n{err[-3000:]}")
         assert f"MP_OK {i}" in out, out[-2000:]
+    return outs
+
+
+def test_two_process_runtime():
+    outs = _run_workers(2)
+    for _, out, _ in outs:
         assert "shard_parallel ok" in out
         assert "pipeshard ok" in out
+
+
+def test_four_process_auto_stage_runtime():
+    """4 processes x 2 devices: AUTO stage construction, planned
+    (packed-tile) cross-process resharding, and a measured per-instruction
+    dispatch latency (VERDICT r2 next#5; SURVEY §7 hard part 5)."""
+    import json
+
+    outs = _run_workers(4, mode="auto", timeout=600)
+    stats, stats4 = None, None
+    for _, out, _ in outs:
+        assert "auto pipeshard ok" in out
+        assert "uniform4 ok" in out
+        for line in out.splitlines():
+            if line.startswith("dispatch_stats "):
+                stats = json.loads(line[len("dispatch_stats "):])
+            elif line.startswith("dispatch_stats4 "):
+                stats4 = json.loads(line[len("dispatch_stats4 "):])
+    assert stats is not None and stats4 is not None
+    assert stats["n_instructions"] > 0
+    # the driver loop must not dominate the step: per-instruction Python
+    # overhead stays under 50 ms even on a loaded CI box (observed ~9 ms
+    # on CPU, where RUN blocks on compute; async backends only enqueue)
+    assert stats["per_inst_us"] < 50_000, stats
+    # the one-stage-per-process leg actually crossed process boundaries
+    # with the packed-tile plan
+    assert stats4["by_opcode"]["RESHARD"]["n"] > 0
+    assert stats4["executed_cross_mesh_bytes"] > 0
 
 
 if __name__ == "__main__":

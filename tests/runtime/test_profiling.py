@@ -183,6 +183,19 @@ class TestMeasuredStageProfiling:
         kb = compute_cost_cache_key(comps, choices, "cost_model",
                                     calibration=cal_b)
         assert ka != kb and ka != base
+        # span-cost strategy and sharding options shape the tensor too
+        assert compute_cost_cache_key(
+            comps, choices, "cost_model", exact_ilp=True) != \
+            compute_cost_cache_key(comps, choices, "cost_model",
+                                   exact_ilp=False)
+        from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+        assert compute_cost_cache_key(
+            comps, choices, "cost_model",
+            sharding_option=AutoShardingOption()) != \
+            compute_cost_cache_key(
+                comps, choices, "cost_model",
+                sharding_option=AutoShardingOption(
+                    prefer_reduce_scatter=True))
 
     def test_cached_compute_cost_end_to_end(self, tmp_path):
         """Full pipeshard compile with cached_compute_cost set: first run
