@@ -62,6 +62,15 @@ class GlobalConfig:
         # "normal" | "no_loadbalance".
         self.resharding_loadbalance_mode = os.environ.get(
             "ALPA_TPU_RESHARDING_LOADBALANCE", "normal")
+        # Pipeline instruction dispatch: "auto" | "sequential" | "threaded".
+        # "threaded" runs the emitter's per-mesh instruction streams on
+        # worker threads (the per-host stream analog of ref
+        # runtime_emitter's per-worker lists); "auto" uses it for
+        # single-process multi-mesh runs.  Multi-process always dispatches
+        # sequentially: collectives must be issued in the same order on
+        # every process.
+        self.pipeline_dispatch_mode = os.environ.get(
+            "ALPA_TPU_PIPELINE_DISPATCH", "auto")
         # Collect timing trace events on the instruction interpreter hot loop.
         self.collect_trace = _env_bool("ALPA_TPU_COLLECT_TRACE", False)
         # Use dummy data for benchmarking (skip real input transfer).

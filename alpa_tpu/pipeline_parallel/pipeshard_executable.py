@@ -12,6 +12,7 @@ interpreter loops (``execute_on_worker``, ref pipeshard_executable.py:489).
 """
 import itertools
 import logging
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -25,7 +26,7 @@ from alpa_tpu.global_env import global_config
 from alpa_tpu.mesh_executable import alloc_zero_buffers
 from alpa_tpu.pipeline_parallel.runtime_emitter import (
     PipelineInstType, PipelineInstruction, PipeshardConfig,
-    PlacementSpecEntry, emit_free_instructions)
+    PlacementSpecEntry, emit_free_instructions, partition_streams)
 from alpa_tpu.pipeline_parallel.schedules import create_pipeline_schedule
 from alpa_tpu.shard_parallel.auto_sharding import MESH_AXIS_NAMES
 from alpa_tpu.timer import timers, tracer
@@ -539,6 +540,11 @@ class PipeshardDriverExecutable:
                 for mb, m in meshes:
                     protected.add((v, mb, m))
         self.instructions = emit_free_instructions(instructions, protected)
+        # pre-partitioned per-mesh worker streams (the reference's
+        # per-host instruction lists, computed once at emit time)
+        self._instruction_streams = partition_streams(
+            self.instructions, self.num_meshes)
+        self._acct_lock = threading.Lock()
         self._const_cache = None
         self._zero_exec_cache = None
 
@@ -640,86 +646,49 @@ class PipeshardDriverExecutable:
             for v, buf in zip(vs, bufs):
                 env.setdefault((v, -1), {})[mesh_id] = buf
 
-        # interpret.  Per-opcode wall time is recorded so the driver-side
-        # dispatch overhead (SURVEY §7 hard part 5: does a single Python
-        # loop keep up with the meshes?) is measurable: on an async
-        # backend RUN returns as soon as the work is enqueued, so
-        # ``last_dispatch_stats`` bounds the per-instruction driver cost.
+        # interpret.  Two dispatch modes (global_config.
+        # pipeline_dispatch_mode):
+        #
+        # * "sequential": one Python loop over the global stream — the
+        #   only collective-safe mode multi-process, where every process
+        #   must issue collectives in the same order.
+        # * "threaded": the emitter's pre-partitioned PER-MESH instruction
+        #   streams (runtime_emitter.partition_streams — the
+        #   single-controller analog of the reference's pre-pushed
+        #   per-worker instruction lists) each run on their own worker
+        #   thread, synchronized by cross-stream dependency events, so a
+        #   slow enqueue on one mesh never stalls dispatch onto another.
+        #
+        # "auto" picks threaded for single-process multi-mesh, sequential
+        # otherwise.  Per-opcode wall time is recorded either way so the
+        # driver-side dispatch overhead (SURVEY §7 hard part 5) is
+        # measurable: on an async backend RUN returns as soon as the work
+        # is enqueued, so ``last_dispatch_stats`` bounds the
+        # per-instruction driver cost.
         collect = global_config.collect_trace
         stats = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}
+        ctx = (env, _put, exec_mode, mp_planned, collect, stats)
+        dmode = getattr(global_config, "pipeline_dispatch_mode", "auto")
+        use_threads = (dmode == "threaded" or
+                       (dmode == "auto" and self.num_meshes > 1)) \
+            and not multiprocess
         loop_tic = time.perf_counter()
-        for inst in self.instructions:
-            inst_tic = time.perf_counter()
-            if inst.opcode == PipelineInstType.RUN:
-                exec_ = inst.executable
-                args = [env[k][inst.dst_mesh] for k in inst.input_keys]
-                # Safety net: the emitter models shardings statically; any
-                # divergence (logged) is reconciled here with a device_put.
-                for i, (a, s) in enumerate(zip(args, exec_.in_shardings)):
-                    if (isinstance(a, jax.Array) and
-                            not a.sharding.is_equivalent_to(s, a.ndim)):
-                        # Happens when one RUN needs the same value in two
-                        # layouts (env holds one layout per mesh).
-                        logger.debug(
-                            "emit-model sharding miss: %s arg[%d] %s -> %s",
-                            inst.info, i, a.sharding.spec, s.spec)
-                        args[i] = _put(a, s)
-                outs = exec_.compiled(*args)
-                for k, o in zip(inst.output_keys, outs):
-                    env.setdefault(k, {})[inst.dst_mesh] = o
-                if collect:
-                    tracer.log("RUN", inst.info)
-            elif inst.opcode == PipelineInstType.RESHARD:
-                val = env[inst.var_key][inst.src_mesh]
-                if (mp_planned and inst.src_mesh != inst.dst_mesh and
-                        inst.plan is not None):
-                    if inst.task is None:
-                        from alpa_tpu.pipeline_parallel. \
-                            cross_mesh_resharding import ReshardingTask
-                        inst.task = ReshardingTask(inst.plan,
-                                                   inst.dst_sharding)
-                    env[inst.var_key][inst.dst_mesh] = \
-                        inst.task.run_multiprocess(val)
-                    rep = inst.task.last_report
-                    self._executed_resharding_bytes += rep.cross_mesh_bytes
-                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
-                elif (exec_mode == "planned" and
-                      inst.src_mesh != inst.dst_mesh and
-                      inst.plan is not None):
-                    # Drive the tile plan literally (per-tile routed
-                    # transfers; send_recv or broadcast leg choice from
-                    # global_config.resharding_mode, ref :418/:935).
-                    if inst.task is None:
-                        from alpa_tpu.pipeline_parallel. \
-                            cross_mesh_resharding import ReshardingTask
-                        inst.task = ReshardingTask(inst.plan,
-                                                   inst.dst_sharding)
-                    mode = ("broadcast" if global_config.resharding_mode ==
-                            "broadcast" else "tiled")
-                    env[inst.var_key][inst.dst_mesh] = inst.task.run(
-                        val, mode)
-                    rep = inst.task.last_report
-                    self._executed_resharding_bytes += rep.cross_mesh_bytes
-                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
-                else:
-                    env[inst.var_key][inst.dst_mesh] = _put(
-                        val, inst.dst_sharding)
-                if collect:
-                    tracer.log("RESHARD", inst.info)
-            else:  # FREE
-                for (v, i, m) in inst.free_keys:
-                    d = env.get((v, i))
-                    if d is not None:
-                        d.pop(m, None)
-            s = stats[inst.opcode.name]
-            s[0] += 1
-            s[1] += time.perf_counter() - inst_tic
+        if use_threads:
+            self._run_streams_threaded(ctx)
+        else:
+            for inst in self.instructions:
+                inst_tic = time.perf_counter()
+                self._exec_inst(inst, ctx)
+                s = stats[inst.opcode.name]
+                s[0] += 1
+                s[1] += time.perf_counter() - inst_tic
         loop_s = time.perf_counter() - loop_tic
         n_inst = max(1, len(self.instructions))
         self.last_dispatch_stats = {
             "n_instructions": len(self.instructions),
             "loop_s": loop_s,
             "per_inst_us": loop_s / n_inst * 1e6,
+            "mode": "threaded" if use_threads else "sequential",
             "by_opcode": {k: {"n": n, "s": t}
                           for k, (n, t) in stats.items()},
         }
@@ -753,6 +722,127 @@ class PipeshardDriverExecutable:
                         "return per-example values or use "
                         "num_micro_batches=1.")
         return outs
+
+    def _exec_inst(self, inst, ctx):
+        """Execute one pipeline instruction (shared by the sequential loop
+        and the per-stream worker threads)."""
+        env, _put, exec_mode, mp_planned, collect, _stats = ctx
+        if inst.opcode == PipelineInstType.RUN:
+            exec_ = inst.executable
+            args = [env[k][inst.dst_mesh] for k in inst.input_keys]
+            # Safety net: the emitter models shardings statically; any
+            # divergence (logged) is reconciled here with a device_put.
+            for i, (a, s) in enumerate(zip(args, exec_.in_shardings)):
+                if (isinstance(a, jax.Array) and
+                        not a.sharding.is_equivalent_to(s, a.ndim)):
+                    # Happens when one RUN needs the same value in two
+                    # layouts (env holds one layout per mesh).
+                    logger.debug(
+                        "emit-model sharding miss: %s arg[%d] %s -> %s",
+                        inst.info, i, a.sharding.spec, s.spec)
+                    args[i] = _put(a, s)
+            outs = exec_.compiled(*args)
+            for k, o in zip(inst.output_keys, outs):
+                env.setdefault(k, {})[inst.dst_mesh] = o
+            if collect:
+                tracer.log("RUN", inst.info)
+        elif inst.opcode == PipelineInstType.RESHARD:
+            val = env[inst.var_key][inst.src_mesh]
+            if (mp_planned and inst.src_mesh != inst.dst_mesh and
+                    inst.plan is not None):
+                if inst.task is None:
+                    from alpa_tpu.pipeline_parallel. \
+                        cross_mesh_resharding import ReshardingTask
+                    inst.task = ReshardingTask(inst.plan, inst.dst_sharding)
+                env[inst.var_key][inst.dst_mesh] = \
+                    inst.task.run_multiprocess(val)
+                rep = inst.task.last_report
+                with self._acct_lock:
+                    self._executed_resharding_bytes += rep.cross_mesh_bytes
+                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
+            elif (exec_mode == "planned" and
+                  inst.src_mesh != inst.dst_mesh and
+                  inst.plan is not None):
+                # Drive the tile plan literally (per-tile routed
+                # transfers; send_recv or broadcast leg choice from
+                # global_config.resharding_mode, ref :418/:935).
+                if inst.task is None:
+                    from alpa_tpu.pipeline_parallel. \
+                        cross_mesh_resharding import ReshardingTask
+                    inst.task = ReshardingTask(inst.plan, inst.dst_sharding)
+                mode = ("broadcast" if global_config.resharding_mode ==
+                        "broadcast" else "tiled")
+                env[inst.var_key][inst.dst_mesh] = inst.task.run(val, mode)
+                rep = inst.task.last_report
+                with self._acct_lock:
+                    self._executed_resharding_bytes += rep.cross_mesh_bytes
+                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
+            else:
+                env[inst.var_key][inst.dst_mesh] = _put(
+                    val, inst.dst_sharding)
+            if collect:
+                tracer.log("RESHARD", inst.info)
+        else:  # FREE
+            for (v, i, m) in inst.free_keys:
+                d = env.get((v, i))
+                if d is not None:
+                    d.pop(m, None)
+
+    def _run_streams_threaded(self, ctx):
+        """Per-mesh worker threads over the emitter's pre-partitioned
+        instruction streams.
+
+        Each worker executes its stream in order; cross-stream data and
+        anti-dependencies (see runtime_emitter.partition_streams) are
+        waited on via per-instruction events.  All dependency edges point
+        to earlier global indices, so workers cannot deadlock; an abort
+        flag stops every stream promptly if one instruction raises.
+        Single-process only: issuing collectives from reordered streams
+        would violate the cross-process same-order contract.
+        """
+        streams = self._instruction_streams
+        n = len(self.instructions)
+        events = [threading.Event() for _ in range(n)]
+        abort = threading.Event()
+        errors: List[BaseException] = []
+        stats = ctx[5]
+
+        def worker(stream):
+            local = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}
+            try:
+                for idx in stream:
+                    for dep in sorted(streams.deps.get(idx, ())):
+                        while not events[dep].wait(0.05):
+                            if abort.is_set():
+                                return
+                    if abort.is_set():
+                        return
+                    inst = self.instructions[idx]
+                    tic = time.perf_counter()
+                    self._exec_inst(inst, ctx)
+                    s = local[inst.opcode.name]
+                    s[0] += 1
+                    s[1] += time.perf_counter() - tic
+                    events[idx].set()
+            except BaseException as e:  # pylint: disable=broad-except
+                errors.append(e)
+                abort.set()
+            finally:
+                with self._acct_lock:
+                    for k, (cnt, sec) in local.items():
+                        stats[k][0] += cnt
+                        stats[k][1] += sec
+
+        threads = [
+            threading.Thread(target=worker, args=(s,), daemon=True)
+            for s in streams.streams if s
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
 
     def __call__(self, *args):
         return self.launch_on_driver(*args)

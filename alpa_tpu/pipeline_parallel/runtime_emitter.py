@@ -91,6 +91,98 @@ class PipeshardConfig:
     schedule_text: str = ""
 
 
+@dataclasses.dataclass
+class InstructionStreams:
+    """Per-mesh instruction streams with cross-stream dependencies — the
+    single-controller analog of the reference's pre-pushed per-worker
+    instruction lists (ref runtime_emitter.py:258 PipelineInstEmitter ->
+    per-worker lists; pipeshard_executable.py:489 execute_on_worker).
+
+    ``streams[m]`` is the ordered list of global instruction indices mesh
+    ``m``'s worker executes; ``deps[i]`` is the set of global indices in
+    OTHER streams instruction ``i`` must wait for.  Dependencies cover
+    read-after-write (a consumer waits for its producer), plus
+    write/kill-after-read anti-dependencies (donating or freeing a buffer
+    waits for every earlier reader) — all edges point to earlier global
+    indices, so stream workers that execute in-stream in order can never
+    deadlock.
+    """
+    streams: List[List[int]]
+    deps: Dict[int, set]
+    stream_of: Dict[int, int]
+
+
+def partition_streams(instructions: List[PipelineInstruction],
+                      num_meshes: int) -> InstructionStreams:
+    """Split the global instruction list into per-mesh streams.
+
+    Assignment: RUN executes on its ``dst_mesh``; RESHARD on its
+    ``dst_mesh`` (the destination initiates the pull, matching the jax
+    transfer model); FREE follows the stream of the preceding
+    instruction — its last user, since emit_free_instructions places
+    each FREE immediately after the last use (stream 0 if the list
+    starts with a FREE).
+    """
+    streams: List[List[int]] = [[] for _ in range(num_meshes)]
+    stream_of: Dict[int, int] = {}
+    deps: Dict[int, set] = {}
+    # key -> ordered access history: (global_idx, stream, kind)
+    history: Dict[Tuple[int, int, int], List[Tuple[int, int, str]]] = {}
+
+    def accesses(inst) -> List[Tuple[Tuple[int, int, int], str]]:
+        acc = []
+        if inst.opcode == PipelineInstType.RUN:
+            ex = getattr(inst, "executable", None)
+            donated = set(getattr(ex, "donate_idx", ()) or ())
+            for pos, k in enumerate(inst.input_keys):
+                kind = "kill" if pos in donated else "read"
+                acc.append(((k[0], k[1], inst.dst_mesh), kind))
+            for k in inst.output_keys:
+                acc.append(((k[0], k[1], inst.dst_mesh), "write"))
+        elif inst.opcode == PipelineInstType.RESHARD:
+            acc.append(
+                ((inst.var_key[0], inst.var_key[1], inst.src_mesh), "read"))
+            acc.append(
+                ((inst.var_key[0], inst.var_key[1], inst.dst_mesh), "write"))
+        else:  # FREE
+            for key in inst.free_keys:
+                acc.append((tuple(key), "kill"))
+        return acc
+
+    prev_stream = 0
+    for i, inst in enumerate(instructions):
+        if inst.opcode == PipelineInstType.RUN:
+            m = inst.dst_mesh
+        elif inst.opcode == PipelineInstType.RESHARD:
+            m = inst.dst_mesh
+        else:
+            m = prev_stream
+        m = m if 0 <= m < num_meshes else 0
+        streams[m].append(i)
+        stream_of[i] = m
+        prev_stream = m
+
+        d = set()
+        for key, kind in accesses(inst):
+            hist = history.setdefault(key, [])
+            if kind == "read":
+                # wait for the latest write from another stream
+                for j, sm, k in reversed(hist):
+                    if k in ("write", "kill"):
+                        if sm != m:
+                            d.add(j)
+                        break
+            else:  # write or kill: wait for every earlier access
+                for j, sm, k in hist:
+                    if sm != m:
+                        d.add(j)
+            hist.append((i, m, kind))
+        if d:
+            deps[i] = d
+    return InstructionStreams(streams=streams, deps=deps,
+                              stream_of=stream_of)
+
+
 def emit_free_instructions(instructions: List[PipelineInstruction],
                            protected_keys) -> List[PipelineInstruction]:
     """Insert FREE after the last use of each (var, inst, mesh) value
