@@ -154,6 +154,44 @@ def get_attention_fn(config: GPTConfig) -> Callable:
     return reference_attention
 
 
+def update_kv_cache(kv_cache, k, v):
+    """Write step K/V into a resident cache and return the attendable
+    views — the mechanics shared by every decoder family (GPT/OPT,
+    Bloom, CodeGen).
+
+    ``kv_cache`` is (k_cache, v_cache, index) with a scalar index
+    (uniform write position) or a (B,) vector (per-row positions for
+    mixed-length continuous batching).  Returns
+    ``(k_use, v_use, new_cache)`` where k_use/v_use are the full-length
+    caches with unwritten positions zeroed (masked from attention by the
+    caller's causal offset) and ``new_cache`` carries index + s.
+    """
+    k_cache, v_cache, index = kv_cache
+    b, s = k.shape[0], k.shape[1]
+    index = jnp.asarray(index, jnp.int32)
+    if index.ndim == 0:
+        k_full = jax.lax.dynamic_update_slice_in_dim(
+            k_cache, k.astype(k_cache.dtype), index, axis=1)
+        v_full = jax.lax.dynamic_update_slice_in_dim(
+            v_cache, v.astype(v_cache.dtype), index, axis=1)
+        keep_len = index + s
+    else:
+        rows = jnp.arange(b)[:, None]
+        cols = index[:, None] + jnp.arange(s)[None, :]
+        k_full = k_cache.at[rows, cols].set(k.astype(k_cache.dtype))
+        v_full = v_cache.at[rows, cols].set(v.astype(v_cache.dtype))
+        keep_len = (index + s)[:, None]
+    pos = jax.lax.broadcasted_iota(jnp.int32, (k_full.shape[1],), 0)
+    keep = pos < keep_len
+    if keep.ndim == 1:
+        keep = keep[None]
+    k_use = jnp.where(keep[:, :, None, None], k_full,
+                      jnp.zeros_like(k_full))
+    v_use = jnp.where(keep[:, :, None, None], v_full,
+                      jnp.zeros_like(v_full))
+    return k_use, v_use, (k_full, v_full, index + s)
+
+
 class SelfAttention(nn.Module):
     config: GPTConfig
 
@@ -170,39 +208,15 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, nh, hd)
         v = v.reshape(b, s, nh, hd)
 
-        offset = 0
         new_cache = None
         if kv_cache is not None:
-            k_cache, v_cache, index = kv_cache
-            index = jnp.asarray(index, jnp.int32)
-            if index.ndim == 0:
-                # uniform write position (classic single-index cache)
-                k_full = jax.lax.dynamic_update_slice_in_dim(
-                    k_cache, k.astype(k_cache.dtype), index, axis=1)
-                v_full = jax.lax.dynamic_update_slice_in_dim(
-                    v_cache, v.astype(v_cache.dtype), index, axis=1)
-                keep_len = index + s                       # scalar
-            else:
-                # per-row write positions: mixed prompt lengths share one
-                # continuously-batched decode (ref wrapper_1d intent)
-                rows = jnp.arange(b)[:, None]
-                cols = index[:, None] + jnp.arange(s)[None, :]
-                k_full = k_cache.at[rows, cols].set(k.astype(k_cache.dtype))
-                v_full = v_cache.at[rows, cols].set(v.astype(v_cache.dtype))
-                keep_len = (index + s)[:, None]            # (B, 1)
-            pos = jax.lax.broadcasted_iota(jnp.int32, (k_full.shape[1],), 0)
-            keep = pos < keep_len                  # (L,) or (B, L)
-            if keep.ndim == 1:
-                keep = keep[None]
-            k_use = jnp.where(keep[:, :, None, None], k_full,
-                              jnp.zeros_like(k_full))
-            v_use = jnp.where(keep[:, :, None, None], v_full,
-                              jnp.zeros_like(v_full))
-            # scores to future positions masked by causal offset
-            attn = reference_attention(q, k_use, v_use, causal=True,
-                                       offset=index)
-            new_cache = (k_full, v_full, index + s)
-            out = attn
+            index = jnp.asarray(kv_cache[2], jnp.int32)
+            k_use, v_use, new_cache = update_kv_cache(kv_cache, k, v)
+            # scores to future positions masked by causal offset;
+            # attn_bias (e.g. the packed-prefill segment mask) rides on
+            # top of the causal mask over the full cache length
+            out = reference_attention(q, k_use, v_use, causal=True,
+                                      offset=index, bias=attn_bias)
         else:
             if attn_bias is not None:
                 # additive padding/score bias: encoder path only (the
@@ -255,14 +269,34 @@ class GPTModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, kv_caches=None,
-                 deterministic=True, return_hidden=False):
+                 deterministic=True, return_hidden=False,
+                 segment_ids=None):
         """``return_hidden=True`` returns the final (B, S, H) hidden states
         instead of logits, for a fused/chunked lm-head + loss (see
-        model_util.chunked_cross_entropy_loss)."""
+        model_util.chunked_cross_entropy_loss).
+
+        ``segment_ids`` (B, S) int32 enables PACKED sequences: tokens only
+        attend within their own segment (block-diagonal mask on top of
+        causal); ids < 0 mark padding that attends to nothing.  This is
+        the TPU-native analog of the reference's 1-D packed batching
+        (ref opt_model_1d.py fused-MHA prompt packing): one row carries
+        many prompts, masked by segments instead of a custom kernel.
+        Pass per-segment ``position_ids`` so positional embeddings
+        restart at each segment start.
+        """
         cfg = self.config
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        seg_bias = None
+        if segment_ids is not None:
+            cache_len = (kv_caches[0][0].shape[1]
+                         if kv_caches is not None else s)
+            seg_k = jnp.pad(segment_ids, ((0, 0), (0, cache_len - s)),
+                            constant_values=-2)
+            same = (segment_ids[:, :, None] == seg_k[:, None, :]) & \
+                (segment_ids[:, :, None] >= 0)
+            seg_bias = jnp.where(same, 0.0, -1e9)[:, None]  # (B,1,S,L)
         tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                            dtype=cfg.dtype, name="wte")
         x = tok_emb(input_ids)
@@ -278,8 +312,10 @@ class GPTModel(nn.Module):
             elif cfg.remat_policy is not None:
                 raise ValueError(
                     f"unknown remat_policy {cfg.remat_policy!r}")
+            # deterministic is static; attn_bias stays a traced pytree
+            # (None or the packed segment mask)
             block_cls = nn.remat(TransformerBlock,
-                                 static_argnums=(2, 3),
+                                 static_argnums=(2,),
                                  policy=policy)
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
@@ -288,7 +324,7 @@ class GPTModel(nn.Module):
                 mark_pipeline_boundary()
             cache_i = kv_caches[i] if kv_caches is not None else None
             x, new_cache = block_cls(cfg, name=f"h{i}")(
-                x, cache_i, deterministic)
+                x, cache_i, deterministic, seg_bias)
             if new_caches is not None:
                 new_caches.append(new_cache)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,

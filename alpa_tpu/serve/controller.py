@@ -122,6 +122,20 @@ class _Replica:
     def __init__(self, generator: Generator):
         self.generator = generator
         self.batcher = RequestBatcher(generator)
+        self._engine = None
+        self._lock = threading.Lock()
+
+    @property
+    def engine(self):
+        """Lazy continuous-batching engine for streaming requests (so
+        non-streaming deployments never spin its decode thread)."""
+        with self._lock:
+            if self._engine is None:
+                from alpa_tpu.serve.engine import ContinuousBatchingEngine
+                self._engine = ContinuousBatchingEngine(
+                    self.generator,
+                    prompt_bucket=self.generator.prompt_buckets[-1])
+            return self._engine
 
 
 class Controller:
@@ -149,23 +163,36 @@ class Controller:
             self._rr[name] += 1
         return replicas[i]
 
-    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+    def _parse_request(self, request: Dict[str, Any]):
+        """Shared request validation: (replica, prompt_ids, cfg) — one
+        parser so streaming and non-streaming cannot diverge."""
         name = request["model"]
         if name not in self._models:
             raise KeyError(f"unknown model {name!r}; "
                            f"registered: {self.list_models()}")
         prompt_ids = np.asarray(request["prompt_ids"], np.int32)
-        if prompt_ids.ndim == 1:
-            prompt_ids = prompt_ids[None]
         cfg = GenerationConfig(
             max_new_tokens=int(request.get("max_new_tokens", 32)),
             temperature=float(request.get("temperature", 1.0)),
             top_k=int(request.get("top_k", 0)),
             do_sample=bool(request.get("do_sample", False)),
             eos_token_id=request.get("eos_token_id"))
-        replica = self._pick_replica(name)
+        return self._pick_replica(name), prompt_ids, cfg
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        replica, prompt_ids, cfg = self._parse_request(request)
+        if prompt_ids.ndim == 1:
+            prompt_ids = prompt_ids[None]
         outs = replica.batcher.submit(list(prompt_ids), cfg)
         return {"output_ids": [o.tolist() for o in outs]}
+
+    def completions_stream(self, request: Dict[str, Any]):
+        """Token iterator for a single-prompt streaming request (rides
+        the replica's continuous-batching engine, so concurrent streams
+        share decode ticks).  Yields ints; the full row is
+        prompt + yielded tokens."""
+        replica, prompt_ids, cfg = self._parse_request(request)
+        return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -197,6 +224,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length", 0))
             request = json.loads(self.rfile.read(length) or b"{}")
+            if request.get("stream"):
+                self._stream(request)
+                return
             result = self.controller.completions(request)
             self._send(200, result)
         except KeyError as e:
@@ -207,6 +237,43 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # pylint: disable=broad-except
             logger.exception("completions failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _stream(self, request):
+        """Server-sent events: one ``data: {"token": t}`` per generated
+        token, then ``data: {"done": true}``.  Close-delimited (no
+        Content-Length; Connection: close) so stdlib clients can read
+        incrementally.
+
+        Validation happens BEFORE headers go out (bad requests still get
+        a JSON error status via do_POST); once streaming has started, any
+        failure is reported as a final ``data: {"error": ...}`` event —
+        never a second status line into the open SSE body.
+        """
+        it = self.controller.completions_stream(request)  # validates
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            try:
+                for t in it:
+                    self.wfile.write(
+                        f"data: {json.dumps({'token': t})}\n\n".encode())
+                    self.wfile.flush()
+                final = {"done": True}
+            except (BrokenPipeError, ConnectionResetError):
+                logger.info("stream client disconnected")
+                return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception("stream failed mid-generation")
+                final = {"error": f"{type(e).__name__}: {e}"}
+            self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            logger.info("stream client disconnected at finish")
+        finally:
+            self.close_connection = True
 
 
 class ControllerServer:

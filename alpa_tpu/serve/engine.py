@@ -25,16 +25,53 @@ from alpa_tpu.serve.generation import (GenerationConfig, Generator,
 
 logger = logging.getLogger(__name__)
 
+_STREAM_END = object()
+
+
+class _DoneEvent(threading.Event):
+    """Event with a completion hook (streams push their end sentinel from
+    whichever engine path finishes the item — success, EOS, or error)."""
+
+    def __init__(self, hook=None):
+        super().__init__()
+        self._hook = hook
+
+    def set(self):
+        if self._hook is not None:
+            try:
+                self._hook()
+            except Exception:  # pylint: disable=broad-except
+                logger.exception("done hook failed")
+        super().set()
+
 
 class ContinuousBatchingEngine:
     """Persistent decode loop with immediate row refill."""
 
     def __init__(self, generator: Generator, max_batch: int = 4,
-                 prompt_bucket: Optional[int] = None):
+                 prompt_bucket: Optional[int] = None,
+                 packed_admission: bool = False,
+                 packed_bucket: Optional[int] = None):
+        """``packed_admission=True`` admits multiple queued prompts with
+        ONE packed prefill (segment-masked, serve.packed.PackedPrefill —
+        the 1-D batching analog) instead of one prefill per row; falls
+        back to per-row prefill when fewer than two prompts wait or the
+        backlog exceeds ``packed_bucket`` total tokens."""
         self.gen = generator
         self.B = max_batch
         self.bucket = prompt_bucket or generator.prompt_buckets[0]
         cfgm = generator.config
+        self._packed = None
+        if packed_admission:
+            from alpa_tpu.serve.packed import PackedPrefill
+            # clamp to the KV-cache capacity: a packed forward longer
+            # than seq_len cannot be written into the caches
+            total = max(packed_bucket or 2 * self.bucket, self.bucket)
+            self._packed = PackedPrefill(
+                generator.model, generator.params, cfgm,
+                total_bucket=min(total, cfgm.seq_len),
+                max_rows=self.B)
+        self.packed_admissions = 0
 
         # resident state: batch KV caches + per-row bookkeeping
         self._caches = init_kv_caches(cfgm, self.B)
@@ -60,14 +97,65 @@ class ContinuousBatchingEngine:
             return new, logits.at[row].set(logits1[0])
 
         self._scatter_row = jax.jit(scatter_row)
+
+        def scatter_packed(caches, rowc, logits, last, rowmap, mask):
+            new = []
+            m4 = mask[:, None, None, None]
+            for (k, v, idx), (rk, rv, rlen) in zip(caches, rowc):
+                new.append((jnp.where(m4, rk[rowmap], k),
+                            jnp.where(m4, rv[rowmap], v),
+                            jnp.where(mask, rlen[rowmap], idx)))
+            return new, jnp.where(mask[:, None], last[rowmap], logits)
+
+        self._scatter_packed = jax.jit(scatter_packed)
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     # ---- public API ----
 
     def submit(self, prompt: np.ndarray,
-               cfg: Optional[GenerationConfig] = None) -> np.ndarray:
-        """Blocking generate for one prompt; rides the shared batch."""
+               cfg: Optional[GenerationConfig] = None,
+               on_token=None) -> np.ndarray:
+        """Blocking generate for one prompt; rides the shared batch.
+        ``on_token(int)`` is invoked from the engine loop as each token
+        lands (streaming hook; must not block)."""
+        item = self._make_item(prompt, cfg, on_token)
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+        item["done"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        row = np.asarray(item["tokens"], np.int32)
+        return np.concatenate([item["prompt"], row])
+
+    def submit_stream(self, prompt: np.ndarray,
+                      cfg: Optional[GenerationConfig] = None):
+        """Iterator over generated tokens as they land (SSE-friendly).
+        Validates and enqueues EAGERLY (so callers can still fail a
+        request before committing to a streamed response); raises at the
+        point of failure if the engine errors mid-stream."""
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        item = self._make_item(prompt, cfg, q.put,
+                               on_done=lambda: q.put(_STREAM_END))
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+
+        def _tokens():
+            while True:
+                t = q.get()
+                if t is _STREAM_END:
+                    break
+                yield int(t)
+            if item["error"] is not None:
+                raise item["error"]
+
+        return _tokens()
+
+    def _make_item(self, prompt, cfg, on_token, on_done=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = cfg or GenerationConfig()
         assert len(prompt) <= self.bucket, (
@@ -77,16 +165,9 @@ class ContinuousBatchingEngine:
                 f"prompt {len(prompt)} + max_new_tokens "
                 f"{cfg.max_new_tokens} exceeds seq_len "
                 f"{self.gen.config.seq_len}")
-        item = {"prompt": prompt, "cfg": cfg,
-                "tokens": [], "done": threading.Event(), "error": None}
-        with self._cv:
-            self._queue.append(item)
-            self._cv.notify()
-        item["done"].wait()
-        if item["error"] is not None:
-            raise item["error"]
-        row = np.asarray(item["tokens"], np.int32)
-        return np.concatenate([prompt, row])
+        return {"prompt": prompt, "cfg": cfg, "tokens": [],
+                "done": _DoneEvent(on_done), "error": None,
+                "on_token": on_token}
 
     def shutdown(self):
         with self._cv:
@@ -96,24 +177,74 @@ class ContinuousBatchingEngine:
     # ---- engine loop ----
 
     def _admit_locked(self):
-        """Fill free rows from the queue (single-row prefill + scatter)."""
+        """Fill free rows from the queue: one packed prefill when several
+        prompts wait (and packing is on), else per-row prefills.
+
+        Admission failures (trace/compile/device errors) fail ONLY the
+        requests being admitted — the engine loop and resident rows
+        survive (a dead loop thread would deadlock every submitter).
+        """
+        if self._packed is not None and len(self._queue) >= 2:
+            free = [r for r in range(self.B) if not self._active[r]]
+            take, total = [], 0
+            while (self._queue and len(take) < len(free) and
+                   total + len(self._queue[0]["prompt"]) <=
+                   self._packed.total_bucket):
+                item = self._queue.pop(0)
+                take.append(item)
+                total += len(item["prompt"])
+            if len(take) >= 2:
+                try:
+                    last, row_caches = self._packed(
+                        [it["prompt"] for it in take])
+                    rowmap = np.zeros((self.B,), np.int32)
+                    mask = np.zeros((self.B,), bool)
+                    for slot, item in enumerate(take):
+                        r = free[slot]
+                        rowmap[r] = slot
+                        mask[r] = True
+                        self._rows[r] = item
+                        self._active[r] = True
+                        self.admissions += 1
+                    self._caches, self._logits = self._scatter_packed(
+                        self._caches, row_caches, self._logits,
+                        last.astype(jnp.float32), jnp.asarray(rowmap),
+                        jnp.asarray(mask))
+                    self.packed_admissions += 1
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.exception("packed admission failed")
+                    for item in take:
+                        item["error"] = e
+                        item["done"].set()
+                        for r in range(self.B):
+                            if self._rows[r] is item:
+                                self._active[r] = False
+                                self._rows[r] = None
+            else:
+                # not enough for a pack: put back and fall through
+                self._queue = take + self._queue
         for r in range(self.B):
             if self._active[r] or not self._queue:
                 continue
             item = self._queue.pop(0)
-            p = item["prompt"]
-            ids = np.zeros((1, self.bucket), np.int32)
-            ids[0, :len(p)] = p
-            caches1 = init_kv_caches(self.gen.config, 1)
-            logits1, caches1 = self.gen._prefill(
-                self.gen.params, jnp.asarray(ids), caches1,
-                jnp.asarray([len(p)], jnp.int32))
-            self._caches, self._logits = self._scatter_row(
-                self._caches, caches1, self._logits,
-                logits1.astype(jnp.float32), r)
-            self._rows[r] = item
-            self._active[r] = True
-            self.admissions += 1
+            try:
+                p = item["prompt"]
+                ids = np.zeros((1, self.bucket), np.int32)
+                ids[0, :len(p)] = p
+                caches1 = init_kv_caches(self.gen.config, 1)
+                logits1, caches1 = self.gen._prefill(
+                    self.gen.params, jnp.asarray(ids), caches1,
+                    jnp.asarray([len(p)], jnp.int32))
+                self._caches, self._logits = self._scatter_row(
+                    self._caches, caches1, self._logits,
+                    logits1.astype(jnp.float32), r)
+                self._rows[r] = item
+                self._active[r] = True
+                self.admissions += 1
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception("row admission failed")
+                item["error"] = e
+                item["done"].set()
 
     def _run(self):
         while True:
@@ -182,6 +313,11 @@ class ContinuousBatchingEngine:
                 cfg = item["cfg"]
                 t = int(nxt[r])
                 item["tokens"].append(t)
+                if item.get("on_token") is not None:
+                    try:
+                        item["on_token"](t)
+                    except Exception:  # pylint: disable=broad-except
+                        logger.exception("on_token callback failed")
                 hit_eos = (cfg.eos_token_id is not None and
                            t == cfg.eos_token_id)
                 if hit_eos or len(item["tokens"]) >= cfg.max_new_tokens:

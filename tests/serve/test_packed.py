@@ -1,0 +1,106 @@
+"""Packed 1-D prefill (VERDICT r2 missing#5; ref opt_model_1d.py /
+wrapper_1d.py): many prompts share one segment-masked forward, and the
+packed KV re-gathers into the continuous-batching engine's row caches.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from alpa_tpu.model.gpt_model import GPTConfig, GPTModel, init_gpt_real
+from alpa_tpu.serve.engine import ContinuousBatchingEngine
+from alpa_tpu.serve.generation import GenerationConfig, Generator
+from alpa_tpu.serve.packed import PackedPrefill, pack_prompts
+
+CFG = GPTConfig(hidden_size=32, num_layers=2, num_heads=4, seq_len=32,
+                vocab_size=64)
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    return init_gpt_real(CFG, 1)
+
+
+PROMPTS = [np.array([1, 2, 3, 4, 5], np.int32),
+           np.array([9, 8, 7], np.int32),
+           np.array([11, 12, 13, 14, 15, 16, 17], np.int32)]
+
+
+class TestSegmentMask:
+
+    def test_packed_logits_match_individual(self, model_params):
+        """Each prompt's logits inside the packed row equal its own
+        standalone forward — segments are perfectly isolated."""
+        model, params = model_params
+        ids, seg, pos, starts, lens = pack_prompts(PROMPTS, 24, 4)
+        packed = np.asarray(model.apply(
+            params, jnp.asarray(ids), jnp.asarray(pos),
+            segment_ids=jnp.asarray(seg)))
+        for r, p in enumerate(PROMPTS):
+            solo = np.asarray(model.apply(params, jnp.asarray(p[None])))
+            span = packed[0, starts[r]:starts[r] + lens[r]]
+            np.testing.assert_allclose(span, solo[0], rtol=2e-4, atol=2e-4)
+
+
+class TestPackedPrefill:
+
+    def test_rows_decode_like_plain_prefill(self, model_params):
+        """Packed prefill + per-row greedy decode == plain generate."""
+        model, params = model_params
+        gen = Generator(model, params, CFG, batch_size=1)
+        pp = PackedPrefill(model, params, CFG, total_bucket=24, max_rows=3)
+        last, row_caches = pp(PROMPTS)
+        assert pp.traces == 1
+
+        for r, p in enumerate(PROMPTS):
+            want = gen.generate(p[None],
+                                GenerationConfig(max_new_tokens=5))
+            # greedy decode row r from the packed caches
+            caches = [(k[r:r + 1], v[r:r + 1], idx[r:r + 1])
+                      for (k, v, idx) in row_caches]
+            toks = [int(np.argmax(np.asarray(last[r])))]
+            for _ in range(4):
+                step, caches = gen._decode(
+                    gen.params, jnp.asarray([[toks[-1]]], jnp.int32),
+                    caches[0][2], caches)
+                toks.append(int(np.argmax(np.asarray(step)[0])))
+            got = np.concatenate([p, np.asarray(toks, np.int32)])
+            np.testing.assert_array_equal(got, want[0])
+
+
+class TestPackedEngine:
+
+    def test_packed_admission_matches_generate(self, model_params):
+        """Engine with packed admission returns the same greedy outputs
+        and actually packs (packed_admissions >= 1)."""
+        import threading
+
+        model, params = model_params
+        gen = Generator(model, params, CFG, batch_size=1,
+                        prompt_buckets=[8, 16])
+        engine = ContinuousBatchingEngine(gen, max_batch=3,
+                                          packed_admission=True,
+                                          packed_bucket=24)
+        try:
+            want = [gen.generate(p[None],
+                                 GenerationConfig(max_new_tokens=6))
+                    for p in PROMPTS]
+            results = [None] * 3
+
+            def do(i):
+                results[i] = engine.submit(
+                    PROMPTS[i], GenerationConfig(max_new_tokens=6))
+
+            ts = [threading.Thread(target=do, args=(i,)) for i in range(3)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for i in range(3):
+                np.testing.assert_array_equal(results[i], want[i][0])
+            assert engine.packed_admissions >= 1
+        finally:
+            engine.shutdown()
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])

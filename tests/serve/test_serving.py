@@ -214,6 +214,37 @@ class TestController:
         finally:
             server.shutdown()
 
+    def test_http_streaming(self):
+        """SSE streaming: tokens arrive as individual events and the
+        assembled row equals the non-streaming greedy result."""
+        server = run_controller(port=0)
+        try:
+            gen = _tiny_generator()
+            server.controller.register_model("tiny", gen)
+            base = f"http://127.0.0.1:{server.port}"
+            body = {"model": "tiny", "prompt_ids": [1, 2, 3],
+                    "max_new_tokens": 5}
+            want = gen.generate(np.array([[1, 2, 3]], np.int32),
+                                GenerationConfig(max_new_tokens=5))
+            req = urllib.request.Request(
+                base + "/completions",
+                data=json.dumps(dict(body, stream=True)).encode(),
+                headers={"Content-Type": "application/json"})
+            events = []
+            with urllib.request.urlopen(req) as r:
+                assert r.headers["Content-Type"] == "text/event-stream"
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(json.loads(line[6:]))
+            toks = [e["token"] for e in events if "token" in e]
+            assert events[-1].get("done") is True
+            assert len(toks) == 5
+            np.testing.assert_array_equal(
+                np.concatenate([[1, 2, 3], toks]), want[0])
+        finally:
+            server.shutdown()
+
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
