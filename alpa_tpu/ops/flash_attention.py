@@ -14,9 +14,12 @@ materializes in HBM.  Two forward paths, picked by k/v size:
   sequence length is then HBM-bound, and ring attention shards beyond
   that.
 
-Backward: ``jax.custom_vjp`` recomputes attention with the einsum reference
-implementation and differentiates that — the standard remat-style tradeoff
-(saves the O(S^2) residuals; XLA fuses the recomputed backward well).
+Backward: real pallas kernels in the VMEM-resident regime — the standard
+two-kernel flash backward (dq over q blocks; dk/dv over k blocks) off the
+saved (out, logsumexp) residuals, never materializing S x S scores.  In
+the HBM-streaming regime (k/v beyond the VMEM budget) the backward falls
+back to q-chunked recompute with the einsum reference implementation —
+the remat-style tradeoff (XLA fuses the recomputed backward well).
 """
 import functools
 from functools import partial
@@ -56,11 +59,14 @@ def _online_softmax_update(q, k_blk, v_blk, m_prev, l_prev, acc, *,
     return m_new, l_new, acc_new
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                      causal: bool, sm_scale: float, q_offset: int):
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k: int, causal: bool, sm_scale: float,
+                      q_offset: int):
     """One (batch*head, q-block) program instance.
 
-    q_ref: (block_q, d); k_ref/v_ref: (s_k, d); o_ref: (block_q, d).
+    q_ref: (block_q, d); k_ref/v_ref: (s_k, d); o_ref: (block_q, d);
+    lse_ref: (block_q,) — per-row logsumexp of the scaled scores, the
+    residual the backward kernels reconstruct P from.
     """
     block_q, d = q_ref.shape
     s_k = k_ref.shape[0]
@@ -93,6 +99,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     m, l, acc = lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-20)
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l)
 
 
 # above this many k/v bytes per (batch, head), stream blocks from HBM
@@ -100,10 +107,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
 VMEM_RESIDENT_LIMIT = 4 * 1024 * 1024
 
 
-def _flash_streaming_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
-                            acc_ref, *, causal: bool, sm_scale: float,
-                            q_offset: int, nk: int, block_q: int,
-                            block_k: int):
+def _flash_streaming_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
+                            l_ref, acc_ref, *, causal: bool,
+                            sm_scale: float, q_offset: int, nk: int,
+                            block_q: int, block_k: int):
     """Grid (B*H, q blocks, k blocks): k/v blocks stream from HBM; the
     online-softmax state (m, l, acc) lives in VMEM scratch that persists
     across the sequential innermost grid dim."""
@@ -138,6 +145,7 @@ def _flash_streaming_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:], 1e-20)
         o_ref[:] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[:] = m_ref[:] + jnp.log(l)
 
 
 def _pick_block(size: int, target: int) -> int:
@@ -152,7 +160,8 @@ def _pick_block(size: int, target: int) -> int:
 def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
                    block_q: int = 256, block_k: int = 256,
                    interpret: bool = None):
-    """q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (B, Sq, H, D)."""
+    """q: (B, Sq, H, D); k/v: (B, Sk, H, D) -> (out (B, Sq, H, D),
+    lse (B*H, Sq))."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     sm_scale = 1.0 / np.sqrt(d)
@@ -184,11 +193,12 @@ def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
         else:
             def kv_index(i, j, kb):
                 return (i, kb, 0)
-        out = pl.pallas_call(
+        out, lse = pl.pallas_call(
             partial(_flash_streaming_kernel, causal=causal,
                     sm_scale=sm_scale, q_offset=q_offset, nk=nk,
                     block_q=block_q, block_k=block_k),
-            out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                       jax.ShapeDtypeStruct((b * h, sq), jnp.float32)),
             grid=grid,
             in_specs=[
                 pl.BlockSpec((None, block_q, d),
@@ -196,8 +206,11 @@ def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
                 pl.BlockSpec((None, block_k, d), kv_index),
                 pl.BlockSpec((None, block_k, d), kv_index),
             ],
-            out_specs=pl.BlockSpec((None, block_q, d),
-                                   lambda i, j, kb: (i, j, 0)),
+            out_specs=(
+                pl.BlockSpec((None, block_q, d),
+                             lambda i, j, kb: (i, j, 0)),
+                pl.BlockSpec((None, block_q), lambda i, j, kb: (i, j)),
+            ),
             scratch_shapes=[
                 pltpu.VMEM((block_q,), jnp.float32),
                 pltpu.VMEM((block_q,), jnp.float32),
@@ -205,33 +218,218 @@ def _flash_forward(q, k, v, *, causal: bool, q_offset: int = 0,
             ],
             interpret=interpret,
         )(qt, kt, vt)
-        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+        return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
     grid = (b * h, pl.cdiv(sq, block_q))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         partial(_flash_fwd_kernel, block_k=block_k, causal=causal,
                 sm_scale=sm_scale, q_offset=q_offset),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, sq), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=(
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, sq, d).transpose(0, 2, 1, 3), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, q_offset):
-    return _flash_forward(q, k, v, causal=causal, q_offset=q_offset)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         sm_scale: float, q_offset: int):
+    """dq for one (batch*head, q-block): loop over k/v blocks up to the
+    diagonal.  P is rebuilt from the saved logsumexp; delta is the
+    precomputed rowsum(dO * O)."""
+    block_q, d = q_ref.shape
+    s_k = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+    q_start = pl.program_id(1) * block_q + q_offset
+
+    def body(kb, dq_acc):
+        k_start = kb * block_k
+        k_blk = k_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    num_k_blocks = pl.cdiv(s_k, block_k)
+    if causal:
+        last_needed = lax.div(q_start + block_q - 1, block_k) + 1
+        n_iter = jnp.minimum(last_needed, num_k_blocks)
+    else:
+        n_iter = num_k_blocks
+    dq = lax.fori_loop(0, n_iter, body,
+                       jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * sm_scale).astype(dq_ref.dtype)
 
 
-def _flash_fwd_rule(q, k, v, causal, q_offset):
-    out = _flash_forward(q, k, v, causal=causal, q_offset=q_offset)
-    return out, (q, k, v)
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          sm_scale: float, q_offset: int):
+    """dk/dv for one (batch*head, k-block): loop over q blocks from the
+    diagonal down."""
+    block_k, d = k_ref.shape
+    s_q = q_ref.shape[0]
+    k_blk = k_ref[:].astype(jnp.float32)
+    v_blk = v_ref[:].astype(jnp.float32)
+    k_start = pl.program_id(1) * block_k
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_start_local = qb * block_q
+        q_start = q_start_local + q_offset
+        q = q_ref[pl.ds(q_start_local, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(q_start_local, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(q_start_local, block_q)]
+        delta = delta_ref[pl.ds(q_start_local, block_q)]
+        s = sm_scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    num_q_blocks = pl.cdiv(s_q, block_q)
+    if causal:
+        # the first q block whose rows can see this k block
+        first = lax.div(jnp.maximum(k_start - q_offset, 0), block_q)
+    else:
+        first = 0
+    z = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(first, num_q_blocks, body, (z, z))
+    dk_ref[:] = (dk * sm_scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward_kernels(q, k, v, out, lse, do, *, causal: bool,
+                            q_offset: int, block_q: int = 256,
+                            block_k: int = 256, interpret: bool = None):
+    """Two-pass flash backward (dq; dk/dv), VMEM-resident regime."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    sm_scale = 1.0 / np.sqrt(d)
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    # delta = rowsum(dO * O): cheap elementwise reduce, XLA-fused
+    delta = jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32),
+                    axis=-1)
+
+    full = lambda i, j: (i, 0, 0)  # noqa: E731
+    full1 = lambda i, j: (i, 0)    # noqa: E731
+    blk = lambda i, j: (i, j, 0)   # noqa: E731
+    blk1 = lambda i, j: (i, j)     # noqa: E731
+
+    dq = pl.pallas_call(
+        partial(_flash_bwd_dq_kernel, block_k=block_k, causal=causal,
+                sm_scale=sm_scale, q_offset=q_offset),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), blk),       # q
+            pl.BlockSpec((None, sk, d), full),           # k
+            pl.BlockSpec((None, sk, d), full),           # v
+            pl.BlockSpec((None, block_q, d), blk),       # do
+            pl.BlockSpec((None, block_q), blk1),         # lse
+            pl.BlockSpec((None, block_q), blk1),         # delta
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), blk),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        partial(_flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                sm_scale=sm_scale, q_offset=q_offset),
+        out_shape=(jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, sk, d), v.dtype)),
+        grid=(b * h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((None, sq, d), full),           # q
+            pl.BlockSpec((None, block_k, d), blk),       # k
+            pl.BlockSpec((None, block_k, d), blk),       # v
+            pl.BlockSpec((None, sq, d), full),           # do
+            pl.BlockSpec((None, sq), full1),             # lse
+            pl.BlockSpec((None, sq), full1),             # delta
+        ],
+        out_specs=(pl.BlockSpec((None, block_k, d), blk),
+                   pl.BlockSpec((None, block_k, d), blk)),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unt = lambda x, s: x.reshape(b, h, s, d).transpose(0, 2, 1, 3)  # noqa: E731
+    return unt(dq, sq), unt(dk, sk), unt(dv, sk)
+
+
+def _bwd_kernels_feasible(q, k) -> bool:
+    """Static predicate: the dq kernel keeps k+v (and the dkv kernel
+    q+do) resident per (batch, head) — beyond the VMEM budget the
+    backward recomputes instead."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    itemsize = jnp.dtype(q.dtype).itemsize
+    return max(2 * sk * d, 2 * sq * d) * itemsize <= VMEM_RESIDENT_LIMIT
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, q_offset, block_q, block_k):
+    return _flash_forward(q, k, v, causal=causal, q_offset=q_offset,
+                          block_q=block_q, block_k=block_k)[0]
+
+
+def _flash_fwd_rule(q, k, v, causal, q_offset, block_q, block_k):
+    out, lse = _flash_forward(q, k, v, causal=causal, q_offset=q_offset,
+                              block_q=block_q, block_k=block_k)
+    if _bwd_kernels_feasible(q, k):
+        return out, (q, k, v, out, lse)
+    # streaming regime: the recompute backward reads only (q, k, v) —
+    # do not hold activation-sized out/lse residuals exactly where
+    # memory is tightest
+    return out, (q, k, v, None, None)
 
 
 def _chunked_reference_attention(q, k, v, *, causal: bool, offset: int,
@@ -255,8 +453,12 @@ def _chunked_reference_attention(q, k, v, *, causal: bool, offset: int,
     return outs.transpose(1, 0, 2, 3, 4).reshape(b, s, h, d)
 
 
-def _flash_bwd_rule(causal, q_offset, res, do):
-    q, k, v = res
+def _flash_bwd_rule(causal, q_offset, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    if out is not None:  # resident regime (see _flash_fwd_rule)
+        return _flash_backward_kernels(q, k, v, out, lse, do,
+                                       causal=causal, q_offset=q_offset,
+                                       block_q=block_q, block_k=block_k)
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _chunked_reference_attention(
             q_, k_, v_, causal=causal, offset=q_offset), q, k, v)
@@ -266,6 +468,9 @@ def _flash_bwd_rule(causal, q_offset, res, do):
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, *, causal: bool = True, offset: int = 0):
-    """Drop-in replacement for ``reference_attention`` (gpt_model.py)."""
-    return _flash_attention(q, k, v, causal, offset)
+def flash_attention(q, k, v, *, causal: bool = True, offset: int = 0,
+                    block_q: int = 256, block_k: int = 256):
+    """Drop-in replacement for ``reference_attention`` (gpt_model.py).
+    ``block_q``/``block_k`` tune the kernel tiling (targets; clipped to
+    divisors of the sequence lengths)."""
+    return _flash_attention(q, k, v, causal, offset, block_q, block_k)

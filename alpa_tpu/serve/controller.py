@@ -192,6 +192,10 @@ class Controller:
         share decode ticks).  Yields ints; the full row is
         prompt + yielded tokens."""
         replica, prompt_ids, cfg = self._parse_request(request)
+        if prompt_ids.ndim > 1 and prompt_ids.shape[0] != 1:
+            raise ValueError(
+                "streaming accepts exactly one prompt per request; got "
+                f"{prompt_ids.shape[0]} rows")
         return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg)
 
 
@@ -264,6 +268,7 @@ class _Handler(BaseHTTPRequestHandler):
                 final = {"done": True}
             except (BrokenPipeError, ConnectionResetError):
                 logger.info("stream client disconnected")
+                it.close()  # flags the engine row cancelled
                 return
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception("stream failed mid-generation")
@@ -272,6 +277,7 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             logger.info("stream client disconnected at finish")
+            it.close()
         finally:
             self.close_connection = True
 

@@ -63,14 +63,26 @@ class ContinuousBatchingEngine:
         cfgm = generator.config
         self._packed = None
         if packed_admission:
-            from alpa_tpu.serve.packed import PackedPrefill
-            # clamp to the KV-cache capacity: a packed forward longer
-            # than seq_len cannot be written into the caches
-            total = max(packed_bucket or 2 * self.bucket, self.bucket)
-            self._packed = PackedPrefill(
-                generator.model, generator.params, cfgm,
-                total_bucket=min(total, cfgm.seq_len),
-                max_rows=self.B)
+            # packing needs segment-mask support AND position-id-based
+            # embeddings (rotary/ALiBi bake GLOBAL positions into the
+            # packed KV, which the row-local re-gather would corrupt) —
+            # GPT/OPT qualify; Bloom/CodeGen take the per-row path
+            import inspect
+            sig = inspect.signature(generator.model.__call__)
+            if "segment_ids" in sig.parameters:
+                from alpa_tpu.serve.packed import PackedPrefill
+                # clamp to the KV-cache capacity: a packed forward
+                # longer than seq_len cannot be written into the caches
+                total = max(packed_bucket or 2 * self.bucket, self.bucket)
+                self._packed = PackedPrefill(
+                    generator.model, generator.params, cfgm,
+                    total_bucket=min(total, cfgm.seq_len),
+                    max_rows=self.B)
+            else:
+                logger.warning(
+                    "packed_admission requested but %s takes no "
+                    "segment_ids — using per-row prefill",
+                    type(generator.model).__name__)
         self.packed_admissions = 0
 
         # resident state: batch KV caches + per-row bookkeeping
@@ -145,11 +157,18 @@ class ContinuousBatchingEngine:
             self._cv.notify()
 
         def _tokens():
-            while True:
-                t = q.get()
-                if t is _STREAM_END:
-                    break
-                yield int(t)
+            try:
+                while True:
+                    t = q.get()
+                    if t is _STREAM_END:
+                        break
+                    yield int(t)
+            except GeneratorExit:
+                # consumer abandoned the stream (client disconnect):
+                # flag the row so the engine frees it next tick instead
+                # of decoding to max_new_tokens for nobody
+                item["cancelled"] = True
+                raise
             if item["error"] is not None:
                 raise item["error"]
 
@@ -167,7 +186,7 @@ class ContinuousBatchingEngine:
                 f"{self.gen.config.seq_len}")
         return {"prompt": prompt, "cfg": cfg, "tokens": [],
                 "done": _DoneEvent(on_done), "error": None,
-                "on_token": on_token}
+                "on_token": on_token, "cancelled": False}
 
     def shutdown(self):
         with self._cv:
@@ -320,7 +339,8 @@ class ContinuousBatchingEngine:
                         logger.exception("on_token callback failed")
                 hit_eos = (cfg.eos_token_id is not None and
                            t == cfg.eos_token_id)
-                if hit_eos or len(item["tokens"]) >= cfg.max_new_tokens:
+                if (hit_eos or item.get("cancelled") or
+                        len(item["tokens"]) >= cfg.max_new_tokens):
                     item["done"].set()
                     self._active[r] = False
                     self._rows[r] = None
