@@ -41,6 +41,18 @@ class CodeGenConfig:
     layer_norm_eps: float = 1e-5
     pipeline_boundary_every: int = 0
 
+    def __post_init__(self):
+        hd = self.hidden_size // self.num_heads
+        if self.rotary_dim > hd:
+            raise ValueError(
+                f"rotary_dim ({self.rotary_dim}) cannot exceed the head "
+                f"dim ({hd} = hidden_size {self.hidden_size} / num_heads "
+                f"{self.num_heads})")
+        if self.rotary_dim % 2 != 0:
+            raise ValueError(
+                f"rotary_dim ({self.rotary_dim}) must be even: rotary "
+                "rotates (2i, 2i+1) dimension pairs")
+
 
 # name -> (hidden, layers, heads, rotary_dim); ref Salesforce/codegen-*
 codegen_specs = {

@@ -1,0 +1,132 @@
+"""Serving throughput microbenchmark: continuous batching + packed
+admission (the analog of ref ``examples/llm_serving/benchmark``).
+
+Measures, on whatever backend is active (CPU mesh or the chip):
+
+* ``generate``   — plain batched Generator.generate throughput,
+* ``engine``     — ContinuousBatchingEngine with per-row admission,
+* ``packed``     — the same engine admitting its backlog via ONE packed
+  segment-masked prefill,
+
+each over the same mixed-length request trace.  Prints one JSON line per
+mode: requests/s, output tokens/s, admissions, packed admissions.
+
+    python benchmark/serving_bench.py [--requests 24] [--model tiny]
+"""
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def make_requests(n, rng, max_len=24):
+    lens = rng.randint(4, max_len, size=n)
+    return [rng.randint(0, 60, size=int(l)).astype(np.int32)
+            for l in lens]
+
+
+def run_engine_mode(gen, requests, new_tokens, packed):
+    from alpa_tpu.serve.engine import ContinuousBatchingEngine
+    from alpa_tpu.serve.generation import GenerationConfig
+
+    engine = ContinuousBatchingEngine(
+        gen, max_batch=4, prompt_bucket=gen.prompt_buckets[-1],
+        packed_admission=packed,
+        packed_bucket=2 * gen.prompt_buckets[-1])
+    cfg = GenerationConfig(max_new_tokens=new_tokens)
+    # warmup compiles (prefill + decode + scatter paths); the packed
+    # executable is warmed directly so its one-time compile stays out of
+    # the measured window
+    engine.submit(requests[0], cfg)
+    if packed and engine._packed is not None:
+        import jax.numpy as jnp
+        last, rows = engine._packed([requests[0], requests[1]])
+        # no-op scatter (all-False mask) warms its executable too
+        engine._scatter_packed(engine._caches, rows, engine._logits,
+                               last.astype(jnp.float32),
+                               jnp.zeros((engine.B,), jnp.int32),
+                               jnp.zeros((engine.B,), bool))
+
+    done = [None] * len(requests)
+
+    def do(i):
+        done[i] = engine.submit(requests[i], cfg)
+
+    tic = time.perf_counter()
+    threads = [threading.Thread(target=do, args=(i,))
+               for i in range(len(requests))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - tic
+    out_tokens = sum(len(d) - len(r) for d, r in zip(done, requests))
+    stats = {"mode": "packed" if packed else "engine",
+             "requests": len(requests), "wall_s": round(wall, 3),
+             "req_per_s": round(len(requests) / wall, 2),
+             "out_tok_per_s": round(out_tokens / wall, 1),
+             "admissions": engine.admissions,
+             "packed_admissions": engine.packed_admissions,
+             "decode_steps": engine.decode_steps}
+    engine.shutdown()
+    return stats
+
+
+def run_generate_mode(gen, requests, new_tokens):
+    from alpa_tpu.serve.generation import GenerationConfig
+
+    cfg = GenerationConfig(max_new_tokens=new_tokens)
+    gen.generate(requests[0][None], cfg)  # warmup
+    tic = time.perf_counter()
+    out_tokens = 0
+    for r in requests:
+        out = gen.generate(r[None], cfg)
+        out_tokens += out.shape[-1] - len(r)
+    wall = time.perf_counter() - tic
+    return {"mode": "generate", "requests": len(requests),
+            "wall_s": round(wall, 3),
+            "req_per_s": round(len(requests) / wall, 2),
+            "out_tok_per_s": round(out_tokens / wall, 1)}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--requests", type=int, default=24)
+    p.add_argument("--new-tokens", type=int, default=16)
+    p.add_argument("--hidden", type=int, default=64)
+    p.add_argument("--layers", type=int, default=2)
+    args = p.parse_args()
+
+    import jax
+
+    import alpa_tpu
+    from alpa_tpu.model.gpt_model import GPTConfig, GPTModel, init_gpt_real
+    from alpa_tpu.serve.generation import Generator
+
+    alpa_tpu.init(cluster="local")
+    cfg = GPTConfig(hidden_size=args.hidden, num_layers=args.layers,
+                    num_heads=max(4, args.hidden // 64), seq_len=128,
+                    vocab_size=256)
+    model, params = init_gpt_real(cfg, 1)
+    gen = Generator(model, params, cfg, batch_size=1,
+                    prompt_buckets=[32])
+    rng = np.random.RandomState(0)
+    requests = make_requests(args.requests, rng)
+
+    print(json.dumps({"platform": jax.devices()[0].platform,
+                      "model": f"h{args.hidden}-l{args.layers}",
+                      "trace": f"{args.requests} reqs, "
+                               f"{args.new_tokens} new tokens"}),
+          flush=True)
+    for stats in (run_generate_mode(gen, requests, args.new_tokens),
+                  run_engine_mode(gen, requests, args.new_tokens,
+                                  packed=False),
+                  run_engine_mode(gen, requests, args.new_tokens,
+                                  packed=True)):
+        print(json.dumps(stats), flush=True)
+
+
+if __name__ == "__main__":
+    main()
