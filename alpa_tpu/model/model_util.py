@@ -71,6 +71,19 @@ def create_adamw(learning_rate=1e-3, weight_decay=0.01, b1=0.9, b2=0.999,
     return optax.chain(*chain)
 
 
+def gpt_lm_loss(apply_fn, params, batch, chunked=False):
+    """LM loss for a GPT-family model with tied embeddings: dense fp32
+    CE, or the fused/chunked lm-head + CE that never materializes the
+    full logits tensor (shared by bench.py and scripts/bench_sweep.py so
+    the measured loss formulation cannot drift between them)."""
+    if chunked:
+        hidden = apply_fn(params, batch["input_ids"], return_hidden=True)
+        emb = params["params"]["wte"]["embedding"]
+        return chunked_cross_entropy_loss(hidden, emb, batch["labels"])
+    logits = apply_fn(params, batch["input_ids"])
+    return cross_entropy_loss(logits.astype(jnp.float32), batch["labels"])
+
+
 def cross_entropy_loss(logits, labels, label_mask=None, vocab_size=None):
     """Mean token cross-entropy with optional mask."""
     loss = optax.softmax_cross_entropy_with_integer_labels(logits, labels)

@@ -183,12 +183,36 @@ def main():
 
     import alpa_tpu
     from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
-    from alpa_tpu.model.model_util import cross_entropy_loss
+    from alpa_tpu.model.model_util import gpt_lm_loss
     from alpa_tpu.util import compute_gpt_tflops
 
     devices = jax.devices()
     on_tpu = devices[0].platform in ("tpu", "axon")
     n_dev = len(devices)
+
+    # Experiment variants, opt-in via env (the DEFAULT stays the known-
+    # good config — never risk the official number on an experiment):
+    #   ALPA_TPU_BENCH_OPT=bf16adam   adam with bf16 first moment (6 B/p
+    #                                 optimizer state instead of 8)
+    #   ALPA_TPU_BENCH_CE=chunked     chunked lm-head+CE (no fp32 logits)
+    #   ALPA_TPU_BENCH_SHAPE=h2048l24 bigger model rung (gated by HBM est)
+    opt_variant = os.environ.get("ALPA_TPU_BENCH_OPT", "adam")
+    ce_variant = os.environ.get("ALPA_TPU_BENCH_CE", "dense")
+    shape_variant = os.environ.get("ALPA_TPU_BENCH_SHAPE", "")
+    shapes = {"": (2048, 16), "h2048l24": (2048, 24), "h2560l16": (2560, 16)}
+    # refuse typos OUTRIGHT: a silently-defaulted variant would burn a
+    # scarce chip run while the result log claims the experiment ran
+    bad = [f"{k}={v!r}" for k, v, ok in (
+        ("ALPA_TPU_BENCH_OPT", opt_variant, ("adam", "bf16adam")),
+        ("ALPA_TPU_BENCH_CE", ce_variant, ("dense", "chunked")),
+        ("ALPA_TPU_BENCH_SHAPE", shape_variant, tuple(shapes)),
+    ) if v not in ok]
+    if bad:
+        print(json.dumps({
+            "metric": "gpt_train_tflops_per_chip", "value": 0.0,
+            "unit": "TFLOPS/chip", "vs_baseline": 0.0,
+            "detail": {"error": f"unknown bench variant(s): {bad}"}}))
+        return
 
     if on_tpu:
         # GPT-1.3B-class config in bf16 (h2048 l16), batch 8 x seq 1024 —
@@ -197,18 +221,24 @@ def main():
         # (66.7 vs 47.7 on 125M); per-block remat is required to fit l16;
         # dense CE beats the chunked variant once logits fit (76.1 vs
         # 75.2).  Never raise batch above 8: the relay wedges.
-        config = GPTConfig(hidden_size=2048, num_layers=16, num_heads=32,
-                           seq_len=1024, vocab_size=51200,
-                           dtype=jnp.bfloat16, attention_impl="reference",
-                           remat_blocks=True)
+        hidden, layers = shapes[shape_variant]
+        # head_dim 64 throughout (the sweep convention): comparable
+        # numbers across shapes, and 64 tiles cleanly on the MXU
+        config = GPTConfig(hidden_size=hidden, num_layers=layers,
+                           num_heads=hidden // 64, seq_len=1024,
+                           vocab_size=51200, dtype=jnp.bfloat16,
+                           attention_impl="reference", remat_blocks=True)
         batch_size = 8
     else:
         config = GPTConfig(hidden_size=256, num_layers=4, num_heads=8,
                            seq_len=256, vocab_size=1024, dtype=jnp.float32)
         batch_size = 8
 
+    opt_bytes = 6.0 if opt_variant == "bf16adam" else 8.0
     if on_tpu:
-        est = estimate_hbm_gb(config, batch_size)
+        est = estimate_hbm_gb(config, batch_size,
+                              optimizer_bytes_per_param=opt_bytes,
+                              chunked_ce=ce_variant == "chunked")
         if est > HBM_GATE_GB:
             print(json.dumps({
                 "metric": "gpt_train_tflops_per_chip", "value": 0.0,
@@ -226,7 +256,11 @@ def main():
     labels = jax.random.randint(rng, (batch_size, config.seq_len), 0,
                                 config.vocab_size)
     params = model.init(rng, input_ids)
-    tx = optax.adam(1e-4)
+    if opt_variant == "bf16adam":
+        # bf16 first moment: 2 B/p saved; the variance stays fp32
+        tx = optax.adam(1e-4, mu_dtype=jnp.bfloat16)
+    else:
+        tx = optax.adam(1e-4)
     from flax.training import train_state
     state = train_state.TrainState.create(apply_fn=model.apply, params=params,
                                           tx=tx)
@@ -236,11 +270,11 @@ def main():
     def train_step(state, batch):
 
         def loss_fn(p):
-            # dense CE beat the chunked variant in the on-chip sweep
-            # (76.1 vs 75.2 TFLOPS at h2048 l16); the fp32 logits fit
-            logits = state.apply_fn(p, batch["input_ids"])
-            return cross_entropy_loss(logits.astype(jnp.float32),
-                                      batch["labels"])
+            # dense CE beat chunked in the on-chip sweep at h2048 l16
+            # (76.1 vs 75.2 TFLOPS); chunked is the variant that frees
+            # the fp32 logits for bigger shape rungs
+            return gpt_lm_loss(state.apply_fn, p, batch,
+                               chunked=ce_variant == "chunked")
 
         loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
         return state.apply_gradients(grads=grads), loss
@@ -271,6 +305,8 @@ def main():
         "vs_baseline": round(tflops / BASELINE_TFLOPS_PER_DEVICE, 4),
         "detail": {
             "model": f"h{config.hidden_size}-l{config.num_layers}",
+            "opt": opt_variant,
+            "ce": ce_variant,
             "batch": batch_size,
             "seq": config.seq_len,
             "latency_s": round(latency, 5),

@@ -12,8 +12,7 @@ from flax.training import train_state
 
 import alpa_tpu
 from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
-from alpa_tpu.model.model_util import (cross_entropy_loss,
-                                       chunked_cross_entropy_loss)
+from alpa_tpu.model.model_util import gpt_lm_loss
 from alpa_tpu.util import compute_gpt_tflops
 
 
@@ -39,15 +38,7 @@ def run_one(attention_impl, remat, chunked, batch_size=8,
                           donate_argnums=(0,))
     def train_step(state, batch):
         def loss_fn(p):
-            if chunked:
-                hidden = state.apply_fn(p, batch["input_ids"],
-                                        return_hidden=True)
-                emb = p["params"]["wte"]["embedding"]
-                return chunked_cross_entropy_loss(hidden, emb,
-                                                  batch["labels"])
-            logits = state.apply_fn(p, batch["input_ids"])
-            return cross_entropy_loss(logits.astype(jnp.float32),
-                                      batch["labels"])
+            return gpt_lm_loss(state.apply_fn, p, batch, chunked=chunked)
         loss, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
         return state.apply_gradients(grads=grads), loss
 
