@@ -176,6 +176,33 @@ def _run_with_recovery(total_budget):
     return 1
 
 
+BENCH_SHAPES = {"": (2048, 16), "h2048l24": (2048, 24),
+                "h2560l16": (2560, 16)}
+
+
+def read_bench_variants():
+    """(opt, ce, shape, errors): the env-selected experiment variants.
+    Checked in BOTH the parent (instantly, before any probing burns the
+    bench window) and the --inner child."""
+    opt = os.environ.get("ALPA_TPU_BENCH_OPT", "adam")
+    ce = os.environ.get("ALPA_TPU_BENCH_CE", "dense")
+    shape = os.environ.get("ALPA_TPU_BENCH_SHAPE", "")
+    errors = [f"{k}={v!r}" for k, v, ok in (
+        ("ALPA_TPU_BENCH_OPT", opt, ("adam", "bf16adam")),
+        ("ALPA_TPU_BENCH_CE", ce, ("dense", "chunked")),
+        ("ALPA_TPU_BENCH_SHAPE", shape, tuple(BENCH_SHAPES)),
+    ) if v not in ok]
+    return opt, ce, shape, errors
+
+
+def _refuse_variants(errors) -> int:
+    print(json.dumps({
+        "metric": "gpt_train_tflops_per_chip", "value": 0.0,
+        "unit": "TFLOPS/chip", "vs_baseline": 0.0,
+        "detail": {"error": f"unknown bench variant(s): {errors}"}}))
+    return 1
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -196,22 +223,11 @@ def main():
     #                                 optimizer state instead of 8)
     #   ALPA_TPU_BENCH_CE=chunked     chunked lm-head+CE (no fp32 logits)
     #   ALPA_TPU_BENCH_SHAPE=h2048l24 bigger model rung (gated by HBM est)
-    opt_variant = os.environ.get("ALPA_TPU_BENCH_OPT", "adam")
-    ce_variant = os.environ.get("ALPA_TPU_BENCH_CE", "dense")
-    shape_variant = os.environ.get("ALPA_TPU_BENCH_SHAPE", "")
-    shapes = {"": (2048, 16), "h2048l24": (2048, 24), "h2560l16": (2560, 16)}
     # refuse typos OUTRIGHT: a silently-defaulted variant would burn a
     # scarce chip run while the result log claims the experiment ran
-    bad = [f"{k}={v!r}" for k, v, ok in (
-        ("ALPA_TPU_BENCH_OPT", opt_variant, ("adam", "bf16adam")),
-        ("ALPA_TPU_BENCH_CE", ce_variant, ("dense", "chunked")),
-        ("ALPA_TPU_BENCH_SHAPE", shape_variant, tuple(shapes)),
-    ) if v not in ok]
+    opt_variant, ce_variant, shape_variant, bad = read_bench_variants()
     if bad:
-        print(json.dumps({
-            "metric": "gpt_train_tflops_per_chip", "value": 0.0,
-            "unit": "TFLOPS/chip", "vs_baseline": 0.0,
-            "detail": {"error": f"unknown bench variant(s): {bad}"}}))
+        _refuse_variants(bad)
         return
 
     if on_tpu:
@@ -221,7 +237,7 @@ def main():
         # (66.7 vs 47.7 on 125M); per-block remat is required to fit l16;
         # dense CE beats the chunked variant once logits fit (76.1 vs
         # 75.2).  Never raise batch above 8: the relay wedges.
-        hidden, layers = shapes[shape_variant]
+        hidden, layers = BENCH_SHAPES[shape_variant]
         # head_dim 64 throughout (the sweep convention): comparable
         # numbers across shapes, and 64 tiles cleanly on the MXU
         config = GPTConfig(hidden_size=hidden, num_layers=layers,
@@ -336,6 +352,12 @@ if __name__ == "__main__":
         # probe program has exactly one definition)
         sys.exit(0 if _probe_once() else 1)
     else:
+        # validate variants HERE too: on a wedged chip the parent would
+        # otherwise spend the whole window probing before the child
+        # could report the typo
+        _bad = read_bench_variants()[3]
+        if _bad:
+            sys.exit(_refuse_variants(_bad))
         budget = 1380.0
         for i, a in enumerate(sys.argv):
             if a == "--self-timeout" and i + 1 < len(sys.argv):

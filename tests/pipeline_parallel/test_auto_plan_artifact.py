@@ -49,3 +49,26 @@ def test_recorded_plans_are_structurally_sane():
     two_host = plans["analytic_v5e_2x8"]
     assert two_host["num_stages"] >= 2
     assert all(h * d <= 8 for h, d in two_host["submesh_shapes"])
+
+
+POD_ARTIFACT = os.path.join(REPO, "benchmark", "results",
+                            "auto_plan_gpt39B_8x8dev.json")
+
+
+@pytest.mark.skipif(not os.path.exists(POD_ARTIFACT),
+                    reason="no committed pod-scale plan artifact")
+def test_pod_scale_39b_plan_structurally_sane():
+    """The recorded GPT-39B 8x8 solution (the analog of the reference's
+    64-GPU recorded plan, suite_auto_gpt.py:80-84): stages partition the
+    auto layers, submeshes cover the pod, and pipeline stages respect
+    the host boundary (no cross-host TP under the analytic ICI/DCN
+    asymmetry)."""
+    with open(POD_ARTIFACT, encoding="utf-8") as f:
+        plan = json.load(f)["analytic_v5e_8x8"]
+    ids = plan["forward_stage_layer_ids"]
+    flat = [i for stage in ids for i in stage]
+    assert flat == list(range(plan["num_layers"]))
+    assert sum(h * d for h, d in plan["submesh_shapes"]) == 64
+    assert plan["num_stages"] >= 4
+    # no cross-host tensor parallelism: every stage mesh is within-host
+    assert all(h == 1 and d <= 8 for h, d in plan["submesh_shapes"])
