@@ -71,6 +71,13 @@ class GlobalConfig:
         # every process.
         self.pipeline_dispatch_mode = os.environ.get(
             "ALPA_TPU_PIPELINE_DISPATCH", "auto")
+        # Runtime race detection for threaded dispatch: every worker
+        # reports its instruction's value accesses; cross-stream
+        # conflicting overlap (a partitioner dependency bug) raises
+        # instead of corrupting numerics.  Debug tool — adds a lock
+        # round-trip per instruction.
+        self.debug_dispatch_races = _env_bool(
+            "ALPA_TPU_DEBUG_DISPATCH_RACES", False)
         # Collect timing trace events on the instruction interpreter hot loop.
         self.collect_trace = _env_bool("ALPA_TPU_COLLECT_TRACE", False)
         # Use dummy data for benchmarking (skip real input transfer).

@@ -806,6 +806,18 @@ class PipeshardDriverExecutable:
         abort = threading.Event()
         errors: List[BaseException] = []
         stats = ctx[5]
+        checker = None
+        if global_config.debug_dispatch_races:
+            # cached across steps (access extraction is per-executable
+            # static work); violations reset per launch
+            checker = getattr(self, "_race_checker", None)
+            if checker is None:
+                from alpa_tpu.pipeline_parallel.runtime_emitter import (
+                    DispatchRaceChecker)
+                checker = DispatchRaceChecker(self.instructions,
+                                              streams.stream_of)
+                self._race_checker = checker
+            checker.violations = []
 
         def worker(stream):
             local = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}
@@ -818,11 +830,14 @@ class PipeshardDriverExecutable:
                     if abort.is_set():
                         return
                     inst = self.instructions[idx]
+                    accs = checker.begin(idx) if checker else None
                     tic = time.perf_counter()
                     self._exec_inst(inst, ctx)
                     s = local[inst.opcode.name]
                     s[0] += 1
                     s[1] += time.perf_counter() - tic
+                    if checker:
+                        checker.end(idx, accs)
                     events[idx].set()
             except BaseException as e:  # pylint: disable=broad-except
                 errors.append(e)
@@ -843,6 +858,8 @@ class PipeshardDriverExecutable:
             t.join()
         if errors:
             raise errors[0]
+        if checker is not None:
+            checker.check()
 
     def __call__(self, *args):
         return self.launch_on_driver(*args)

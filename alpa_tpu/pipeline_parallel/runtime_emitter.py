@@ -112,6 +112,31 @@ class InstructionStreams:
     stream_of: Dict[int, int]
 
 
+def instruction_accesses(inst) -> List[Tuple[Tuple[int, int, int], str]]:
+    """The (value key, access kind) pairs one instruction touches —
+    kind "read" | "write" | "kill" (donation or FREE).  Shared by the
+    stream partitioner (dependency edges) and the dispatch race checker
+    (runtime conflict detection)."""
+    acc = []
+    if inst.opcode == PipelineInstType.RUN:
+        ex = getattr(inst, "executable", None)
+        donated = set(getattr(ex, "donate_idx", ()) or ())
+        for pos, k in enumerate(inst.input_keys):
+            kind = "kill" if pos in donated else "read"
+            acc.append(((k[0], k[1], inst.dst_mesh), kind))
+        for k in inst.output_keys:
+            acc.append(((k[0], k[1], inst.dst_mesh), "write"))
+    elif inst.opcode == PipelineInstType.RESHARD:
+        acc.append(
+            ((inst.var_key[0], inst.var_key[1], inst.src_mesh), "read"))
+        acc.append(
+            ((inst.var_key[0], inst.var_key[1], inst.dst_mesh), "write"))
+    else:  # FREE
+        for key in inst.free_keys:
+            acc.append((tuple(key), "kill"))
+    return acc
+
+
 def partition_streams(instructions: List[PipelineInstruction],
                       num_meshes: int) -> InstructionStreams:
     """Split the global instruction list into per-mesh streams.
@@ -129,26 +154,6 @@ def partition_streams(instructions: List[PipelineInstruction],
     # key -> ordered access history: (global_idx, stream, kind)
     history: Dict[Tuple[int, int, int], List[Tuple[int, int, str]]] = {}
 
-    def accesses(inst) -> List[Tuple[Tuple[int, int, int], str]]:
-        acc = []
-        if inst.opcode == PipelineInstType.RUN:
-            ex = getattr(inst, "executable", None)
-            donated = set(getattr(ex, "donate_idx", ()) or ())
-            for pos, k in enumerate(inst.input_keys):
-                kind = "kill" if pos in donated else "read"
-                acc.append(((k[0], k[1], inst.dst_mesh), kind))
-            for k in inst.output_keys:
-                acc.append(((k[0], k[1], inst.dst_mesh), "write"))
-        elif inst.opcode == PipelineInstType.RESHARD:
-            acc.append(
-                ((inst.var_key[0], inst.var_key[1], inst.src_mesh), "read"))
-            acc.append(
-                ((inst.var_key[0], inst.var_key[1], inst.dst_mesh), "write"))
-        else:  # FREE
-            for key in inst.free_keys:
-                acc.append((tuple(key), "kill"))
-        return acc
-
     prev_stream = 0
     for i, inst in enumerate(instructions):
         if inst.opcode == PipelineInstType.RUN:
@@ -163,7 +168,7 @@ def partition_streams(instructions: List[PipelineInstruction],
         prev_stream = m
 
         d = set()
-        for key, kind in accesses(inst):
+        for key, kind in instruction_accesses(inst):
             hist = history.setdefault(key, [])
             if kind == "read":
                 # wait for the latest write from another stream
@@ -181,6 +186,68 @@ def partition_streams(instructions: List[PipelineInstruction],
             deps[i] = d
     return InstructionStreams(streams=streams, deps=deps,
                               stream_of=stream_of)
+
+
+class DispatchRaceChecker:
+    """Runtime race detector for threaded per-mesh dispatch (SURVEY §5
+    race detection — a capability the reference does not have).
+
+    With ``global_config.debug_dispatch_races`` on, every worker reports
+    its instruction's value accesses before executing and withdraws them
+    after.  Two accesses CONFLICT when they touch the same (var,
+    microbatch, mesh) key from different streams and at least one is a
+    write or kill (donation/FREE).  A conflict observed live means the
+    partitioner's dependency edges failed to serialize the pair — the
+    exact bug class that would otherwise surface as silent numeric
+    corruption or a use-after-donate crash far from its cause.
+    """
+
+    def __init__(self, instructions, stream_of):
+        import threading
+        self._stream_of = stream_of
+        # instructions and streams are fixed for the executable's
+        # lifetime: extract every access list once, not per step
+        self._accs = [instruction_accesses(i) for i in instructions]
+        self._lock = threading.Lock()
+        # key -> {idx: kind} of instructions currently executing
+        self._active: Dict[Tuple, Dict[int, str]] = {}
+        self.violations: List[str] = []
+
+    @staticmethod
+    def _conflict(a: str, b: str) -> bool:
+        return a != "read" or b != "read"
+
+    def begin(self, idx: int):
+        accs = self._accs[idx]
+        me = self._stream_of[idx]
+        with self._lock:
+            for key, kind in accs:
+                holders = self._active.setdefault(key, {})
+                for other, okind in holders.items():
+                    if self._stream_of[other] != me and \
+                            self._conflict(kind, okind):
+                        self.violations.append(
+                            f"inst {idx} ({kind} {key}) raced inst "
+                            f"{other} ({okind}) across streams "
+                            f"{me}/{self._stream_of[other]}")
+                holders[idx] = kind
+        return accs
+
+    def end(self, idx: int, accs):
+        with self._lock:
+            for key, _ in accs:
+                holders = self._active.get(key)
+                if holders is not None:
+                    holders.pop(idx, None)
+                    if not holders:
+                        self._active.pop(key, None)
+
+    def check(self):
+        if self.violations:
+            raise RuntimeError(
+                "threaded dispatch raced (stream dependency edges failed "
+                "to serialize conflicting accesses):\n  " +
+                "\n  ".join(self.violations[:10]))
 
 
 def emit_free_instructions(instructions: List[PipelineInstruction],

@@ -85,6 +85,92 @@ class TestPartitionStreams:
             assert all(st.stream_of[d] != st.stream_of[i] for d in deps)
 
 
+class TestDispatchRaceChecker:
+
+    def _insts(self):
+        return [
+            _run(0, 0, 0, [("x", 0)], [("a", 0)]),
+            _run(1, 0, 1, [("a", 0)], [("b", 0)]),
+        ]
+
+    def test_detects_cross_stream_conflict(self):
+        """Concurrent write/read of one key from different streams is a
+        violation (simulating a missing dependency edge)."""
+        from alpa_tpu.pipeline_parallel.runtime_emitter import (
+            DispatchRaceChecker)
+        insts = self._insts()
+        # both instructions touch ("a", 0) on mesh... make them conflict:
+        # inst 0 writes (a,0)@0; craft inst 1 to read (a,0)@0 from
+        # stream 1 (as if a RESHARD pulled from mesh 0)
+        insts[1] = PipelineInstruction(
+            PipelineInstType.RESHARD, var_key=("a", 0), src_mesh=0,
+            dst_mesh=1, dst_sharding=None)
+        chk = DispatchRaceChecker(insts, {0: 0, 1: 1})
+        a0 = chk.begin(0)           # write in flight on stream 0
+        chk.begin(1)                # concurrent cross-stream read
+        assert chk.violations, "expected a write/read race"
+        chk.end(0, a0)
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError, match="raced"):
+            chk.check()
+
+    def test_serialized_accesses_are_clean(self):
+        from alpa_tpu.pipeline_parallel.runtime_emitter import (
+            DispatchRaceChecker)
+        insts = self._insts()
+        chk = DispatchRaceChecker(insts, {0: 0, 1: 1})
+        a0 = chk.begin(0)
+        chk.end(0, a0)
+        a1 = chk.begin(1)           # after the writer finished: fine
+        chk.end(1, a1)
+        assert not chk.violations
+        chk.check()
+
+    def test_reads_do_not_conflict(self):
+        from alpa_tpu.pipeline_parallel.runtime_emitter import (
+            DispatchRaceChecker)
+        insts = [
+            _run(0, 0, 0, [("x", 0)], [("a", 0)]),
+            _run(1, 0, 1, [("x", 0)], [("b", 0)]),
+        ]
+        # same key read concurrently from two streams: no violation...
+        # except the keys differ by mesh here, so craft same-mesh reads
+        insts[1] = _run(1, 0, 0, [("x", 0)], [("b", 0)])
+        chk = DispatchRaceChecker(insts, {0: 0, 1: 1})
+        a0 = chk.begin(0)
+        a1 = chk.begin(1)
+        # ("x",0)@0 read concurrently: fine; the writes target different
+        # keys ("a" vs "b")
+        assert not chk.violations
+        chk.end(0, a0)
+        chk.end(1, a1)
+
+    def test_end_to_end_clean_under_detector(self):
+        """A full threaded pipeshard run under the detector reports no
+        violations — the partitioner's edges serialize every conflict."""
+        alpa_tpu.init(cluster="local")
+        global_config.debug_dispatch_races = True
+        global_config.pipeline_dispatch_mode = "threaded"
+        try:
+            state, batch = create_mlp_train_state_and_batch(
+                batch_size=64, num_layers=4, manual_pipeline_layer=True)
+            method = PipeshardParallel(
+                num_micro_batches=4,
+                layer_option=ManualLayerOption(),
+                stage_option=UniformStageOption(num_stages=2))
+            step = get_mlp_train_step(method, use_value_and_grad=True)
+            for _ in range(3):
+                state, loss = step(state, batch)
+            import math
+            assert math.isfinite(float(loss))
+            ex = step.get_last_executable()
+            # the detector only certifies anything if threads actually ran
+            assert ex.last_dispatch_stats["mode"] == "threaded"
+        finally:
+            global_config.debug_dispatch_races = False
+            global_config.pipeline_dispatch_mode = "auto"
+
+
 class TestThreadedDispatch:
 
     def test_threaded_matches_sequential(self):
