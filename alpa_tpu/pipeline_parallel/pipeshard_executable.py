@@ -817,7 +817,10 @@ class PipeshardDriverExecutable:
                 checker = DispatchRaceChecker(self.instructions,
                                               streams.stream_of)
                 self._race_checker = checker
+            # full reset: an aborted launch can leave in-flight accesses
+            # registered, which would read as false races on retry
             checker.violations = []
+            checker._active = {}
 
         def worker(stream):
             local = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}
@@ -832,12 +835,14 @@ class PipeshardDriverExecutable:
                     inst = self.instructions[idx]
                     accs = checker.begin(idx) if checker else None
                     tic = time.perf_counter()
-                    self._exec_inst(inst, ctx)
+                    try:
+                        self._exec_inst(inst, ctx)
+                    finally:
+                        if checker:
+                            checker.end(idx, accs)
                     s = local[inst.opcode.name]
                     s[0] += 1
                     s[1] += time.perf_counter() - tic
-                    if checker:
-                        checker.end(idx, accs)
                     events[idx].set()
             except BaseException as e:  # pylint: disable=broad-except
                 errors.append(e)

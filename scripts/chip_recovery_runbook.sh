@@ -1,0 +1,49 @@
+#!/bin/bash
+# Chip-recovery runbook: the exact measurement sequence to run when the
+# axon relay clears, most-valuable-first, each leg gated and guarded.
+#
+# Discipline (see bench.py header): every leg runs in a child process
+# with a hard timeout; a probe runs BETWEEN legs and the runbook STOPS
+# at the first wedge sign so one bad leg cannot take the rest down; all
+# configs pre-validated against the HBM estimator (the relay wedges on
+# near-OOM programs and stays wedged for hours).
+#
+#   bash scripts/chip_recovery_runbook.sh [results_file]
+#
+# Legs (in order):
+#   1. known-good bench (h2048-l16 bs8, the official number) — FIRST,
+#      so whatever happens later the round has a recorded result
+#   2. bf16 adam moment variant (est 13.8 GB < gate)
+#   3. h2048-l24 + bf16adam + chunked CE (est 14.7 GB < gate)
+#   4. flash-vs-XLA longseq compare (attention-only, est << gate)
+#   5. flash block-size sweep at seq 4096
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-benchmark/results/recovery_run.jsonl}"
+mkdir -p "$(dirname "$OUT")"
+
+probe() {
+    timeout 120 python bench.py --probe
+}
+
+leg() {
+    local name="$1"; shift
+    echo "=== leg: $name" | tee -a "$OUT"
+    if ! probe; then
+        echo "{\"leg\": \"$name\", \"skipped\": \"probe failed - stopping\"}" \
+            | tee -a "$OUT"
+        exit 1
+    fi
+    "$@" 2>>"$OUT.err" | tee -a "$OUT"
+}
+
+leg known-good       timeout 600 python bench.py --self-timeout 540
+leg bf16adam         env ALPA_TPU_BENCH_OPT=bf16adam \
+                     timeout 600 python bench.py --self-timeout 540
+leg h2048l24-lean    env ALPA_TPU_BENCH_OPT=bf16adam \
+                         ALPA_TPU_BENCH_CE=chunked \
+                         ALPA_TPU_BENCH_SHAPE=h2048l24 \
+                     timeout 700 python bench.py --self-timeout 640
+leg flash-compare    timeout 600 python scripts/flash_longseq_bench.py compare
+leg flash-blocks     timeout 600 python scripts/flash_longseq_bench.py blocks
+echo "=== runbook complete" | tee -a "$OUT"
