@@ -218,12 +218,13 @@ class MoEBlock(nn.Module):
     use_moe: bool
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, kv_cache=None):
         cfg = self.config
         gcfg = cfg.gpt()
         ln1 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                            name="ln1")(x)
-        attn_out, _ = SelfAttention(gcfg, name="attn")(ln1)
+        attn_out, new_cache = SelfAttention(gcfg, name="attn")(ln1,
+                                                               kv_cache)
         x = x + attn_out.astype(x.dtype)
         ln2 = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                            name="ln2")(x)
@@ -236,33 +237,62 @@ class MoEBlock(nn.Module):
             y = nn.gelu(y, approximate=True)
             mlp_out = nn.Dense(h, dtype=cfg.dtype, name="fc_out")(y)
             aux = jnp.float32(0.0)
-        return x + mlp_out.astype(x.dtype), aux
+        return x + mlp_out.astype(x.dtype), aux, new_cache
 
 
 class MoELMModel(nn.Module):
     """Decoder LM with alternating dense / MoE blocks
-    (ref benchmark/alpa/suite_auto_moe.py model family)."""
+    (ref benchmark/alpa/suite_auto_moe.py model family).
+
+    Training call: ``(logits, aux_loss) = apply(params, ids)``.
+    Serving call (Mixtral-style MoE decoding): pass ``kv_caches`` and
+    get ``(logits, new_caches)`` back — the gpt_model cache-as-invars
+    contract, so the Generator / continuous-batching engine drive MoE
+    models unchanged (routing happens per decoded token; the aux loss is
+    an optimization-only term and is dropped in inference).
+
+    SERVING CAPACITY CAVEAT: bucket-padded prefill feeds pad tokens into
+    top-2 routing, and capacity slots go by token order — with
+    ``capacity_factor < num_experts`` pads can steal expert capacity
+    from real tokens and change their logits.  Serve with
+    ``capacity_factor >= num_experts`` (no-drop regime; the Generator
+    warns otherwise).  Training is unaffected (no padding there).
+    """
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, position_ids=None, kv_caches=None):
         cfg = self.config
         b, s = input_ids.shape
-        pos = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        if position_ids is None:
+            position_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        name="wte")
         x = emb(input_ids) + nn.Embed(cfg.seq_len, cfg.hidden_size,
-                                      dtype=cfg.dtype, name="wpe")(pos)
+                                      dtype=cfg.dtype,
+                                      name="wpe")(position_ids)
         aux_total = jnp.float32(0.0)
+        new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):
             use_moe = (cfg.moe_every > 0 and
                        (i + 1) % cfg.moe_every == 0)
-            x, aux = MoEBlock(cfg, use_moe, name=f"h{i}")(x)
+            cache_i = kv_caches[i] if kv_caches is not None else None
+            x, aux, c = MoEBlock(cfg, use_moe, name=f"h{i}")(x, cache_i)
             aux_total = aux_total + aux
+            if new_caches is not None:
+                new_caches.append(c)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=jnp.float32,
                          name="ln_f")(x)
         logits = emb.attend(x.astype(cfg.dtype))
+        if new_caches is not None:
+            return logits, new_caches
         return logits, aux_total
+
+
+def init_moe_kv_caches(config: MoEConfig, batch_size: int,
+                       dtype=None) -> list:
+    from alpa_tpu.model.gpt_model import init_kv_caches
+    return init_kv_caches(config.gpt(), batch_size, dtype)
 
 
 # Benchmark ladder (ref benchmark/alpa/suite_auto_moe.py)

@@ -817,10 +817,7 @@ class PipeshardDriverExecutable:
                 checker = DispatchRaceChecker(self.instructions,
                                               streams.stream_of)
                 self._race_checker = checker
-            # full reset: an aborted launch can leave in-flight accesses
-            # registered, which would read as false races on retry
-            checker.violations = []
-            checker._active = {}
+            checker.reset()
 
         def worker(stream):
             local = {"RUN": [0, 0.0], "RESHARD": [0, 0.0], "FREE": [0, 0.0]}

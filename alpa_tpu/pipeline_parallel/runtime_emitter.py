@@ -242,6 +242,13 @@ class DispatchRaceChecker:
                     if not holders:
                         self._active.pop(key, None)
 
+    def reset(self):
+        """Clear violations AND in-flight accesses (an aborted launch can
+        leave registrations behind); call at the start of every launch."""
+        with self._lock:
+            self._active = {}
+            self.violations = []
+
     def check(self):
         if self.violations:
             raise RuntimeError(

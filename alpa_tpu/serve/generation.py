@@ -89,6 +89,18 @@ class Generator:
                                      default_prompt_buckets(config.seq_len))
         self.prefill_traces = 0
         self.decode_traces = 0
+        # MoE capacity hazard: bucket pads enter routing and can steal
+        # expert capacity from real tokens below the no-drop regime
+        # (see MoELMModel docstring)
+        cap = getattr(config, "capacity_factor", None)
+        n_exp = getattr(config, "num_experts", None)
+        if cap is not None and n_exp is not None and cap < n_exp:
+            logger.warning(
+                "serving an MoE config with capacity_factor (%s) < "
+                "num_experts (%s): padded prefill tokens can steal "
+                "expert capacity and change real tokens' logits — use "
+                "capacity_factor >= num_experts for exact serving", cap,
+                n_exp)
 
         def prefill(params, input_ids, caches, lengths):
             self.prefill_traces += 1

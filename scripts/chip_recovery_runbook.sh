@@ -17,7 +17,7 @@
 #   3. h2048-l24 + bf16adam + chunked CE (est 14.7 GB < gate)
 #   4. flash-vs-XLA longseq compare (attention-only, est << gate)
 #   5. flash block-size sweep at seq 4096
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 OUT="${1:-benchmark/results/recovery_run.jsonl}"
 mkdir -p "$(dirname "$OUT")"
@@ -34,7 +34,13 @@ leg() {
             | tee -a "$OUT"
         exit 1
     fi
-    "$@" 2>>"$OUT.err" | tee -a "$OUT"
+    # a failed leg is RECORDED (not mistaken for success) and the
+    # runbook continues — the next leg's probe decides whether the
+    # chip is still usable
+    if ! "$@" 2>>"$OUT.err" | tee -a "$OUT"; then
+        echo "{\"leg\": \"$name\", \"failed_rc\": ${PIPESTATUS[0]}}" \
+            | tee -a "$OUT"
+    fi
 }
 
 leg known-good       timeout 600 python bench.py --self-timeout 540
