@@ -291,8 +291,10 @@ class MoELMModel(nn.Module):
 
 def init_moe_kv_caches(config: MoEConfig, batch_size: int,
                        dtype=None) -> list:
+    """EXACTLY what the serving Generator builds for this config — one
+    init path, so tests and serving cannot drift apart."""
     from alpa_tpu.model.gpt_model import init_kv_caches
-    return init_kv_caches(config.gpt(), batch_size, dtype)
+    return init_kv_caches(config, batch_size, dtype)
 
 
 # Benchmark ladder (ref benchmark/alpa/suite_auto_moe.py)

@@ -36,10 +36,12 @@ leg() {
     fi
     # a failed leg is RECORDED (not mistaken for success) and the
     # runbook continues — the next leg's probe decides whether the
-    # chip is still usable
-    if ! "$@" 2>>"$OUT.err" | tee -a "$OUT"; then
-        echo "{\"leg\": \"$name\", \"failed_rc\": ${PIPESTATUS[0]}}" \
-            | tee -a "$OUT"
+    # chip is still usable.  Guard on the LEG's status, not the
+    # pipeline's (a tee failure must not forge a failed_rc: 0 record).
+    "$@" 2>>"$OUT.err" | tee -a "$OUT"
+    local rc=${PIPESTATUS[0]}
+    if [ "$rc" -ne 0 ]; then
+        echo "{\"leg\": \"$name\", \"failed_rc\": $rc}" | tee -a "$OUT"
     fi
 }
 

@@ -381,3 +381,34 @@ class TestPipelinedGeneration:
         np.testing.assert_array_equal(np.asarray(g1), np.asarray(g3))
         assert piped.prefill_traces == p_traces
         assert piped.decode_traces == d_traces
+
+    def test_pipelined_bloom_matches_plain(self):
+        """A second family through the pipelined-inference path: the
+        cache-as-invars contract composes with stage-resident KV caches
+        for ALiBi models too, not just GPT."""
+        import alpa_tpu
+        from alpa_tpu import PipeshardParallel
+        from alpa_tpu.model.bloom_model import BloomConfig, BloomModel
+        from alpa_tpu.pipeline_parallel.layer_construction import (
+            ManualLayerOption)
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            UniformStageOption)
+
+        alpa_tpu.init(cluster="local")
+        cfg = BloomConfig(hidden_size=32, num_layers=2, num_heads=4,
+                          seq_len=32, vocab_size=64,
+                          pipeline_boundary_every=1)
+        model = BloomModel(cfg)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.ones((1, 8), jnp.int32))
+        plain = Generator(model, params, cfg)
+        piped = Generator(
+            model, params, cfg,
+            parallel_method=PipeshardParallel(
+                num_micro_batches=1, layer_option=ManualLayerOption(),
+                stage_option=UniformStageOption(num_stages=2),
+                pipeline_schedule="inference"))
+        ids = np.random.RandomState(1).randint(0, 64, (1, 8))
+        g1 = plain.generate(ids, GenerationConfig(max_new_tokens=6))
+        g2 = piped.generate(ids, GenerationConfig(max_new_tokens=6))
+        np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
