@@ -73,13 +73,20 @@ class Generator:
     def __init__(self, model: GPTModel, params, config: GPTConfig,
                  batch_size: int = 1,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 parallel_method: Optional[Any] = None):
+                 parallel_method: Optional[Any] = None,
+                 prefill_chunk: Optional[int] = None):
         """``parallel_method``: optional alpa_tpu ParallelMethod for the
         prefill/decode executables — e.g. ``PipeshardParallel(
         pipeline_schedule="inference")`` with a layer-marked model config
         gives pipelined inference with per-stage-resident KV caches (ref
         get_pipeshard_executable, opt_model.py:770); cache outputs keep
         their stage placement so the next decode's device_put is a no-op.
+
+        ``prefill_chunk``: CHUNKED prefill — prompts stream through the
+        cached decode-style path in fixed-size chunks, so ONE compiled
+        step serves every prompt length (no bucket ladder, no per-bucket
+        compiles; the long-context serving mode).  Positions enter via
+        the cache write index, so it applies to every decoder family.
         """
         self.model = model
         self.params = params
@@ -118,20 +125,65 @@ class Generator:
             logits, caches = model.apply(params, token, pos, caches)
             return logits[:, 0, :], caches
 
+        self.prefill_chunk = prefill_chunk
+
+        def chunk_prefill(params, ids_chunk, lengths, caches, last):
+            """One fixed-shape chunk through the cached path.  The
+            chunk's absolute start position rides the caches' scalar
+            write index; ``last`` accumulates each row's final-token
+            logits from whichever chunk contains position length-1."""
+            self.prefill_traces += 1
+            b, c = ids_chunk.shape
+            start = caches[0][2]                     # scalar chunk start
+            pos = start + jax.lax.broadcasted_iota(jnp.int32, (b, c), 1)
+            logits, caches = model.apply(params, ids_chunk, pos, caches)
+            off = lengths - 1 - start                # (B,)
+            hit = (off >= 0) & (off < c)
+            sel = logits[jnp.arange(b), jnp.clip(off, 0, c - 1)]
+            last = jnp.where(hit[:, None], sel, last)
+            return last, caches
+
         if parallel_method is not None:
             import alpa_tpu
             self._prefill = alpa_tpu.parallelize(
                 prefill, method=parallel_method, donate_argnums=())
             self._decode = alpa_tpu.parallelize(
                 decode, method=parallel_method, donate_argnums=())
+            self._chunk_prefill = alpa_tpu.parallelize(
+                chunk_prefill, method=parallel_method, donate_argnums=())
         else:
             self._prefill = jax.jit(prefill)
             self._decode = jax.jit(decode)
+            self._chunk_prefill = jax.jit(chunk_prefill)
         # beam-search KV-cache gather, compiled once (per cache shapes)
         self._reorder = jax.jit(
             lambda caches, idx: jax.tree_util.tree_map(
                 lambda x: jnp.take(x, idx, axis=0)
                 if hasattr(x, "ndim") and x.ndim > 0 else x, caches))
+
+    def _run_chunked_prefill(self, prompts, lengths_j, b):
+        """Stream the prompts through the fixed-shape chunk step: one
+        compile covers every prompt length."""
+        c = self.prefill_chunk
+        s_max = int(max(len(p) for p in prompts))
+        n_chunks = max(1, -(-s_max // c))
+        assert n_chunks * c <= self.config.seq_len, (
+            f"chunked prefill of {s_max} tokens pads to {n_chunks * c}, "
+            f"exceeding the KV capacity (seq_len {self.config.seq_len}); "
+            f"use a chunk size dividing seq_len or a shorter prompt")
+        ids = np.zeros((b, n_chunks * c), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+        caches = init_kv_caches(self.config, b)   # scalar index 0
+        last = jnp.zeros((b, self.config.vocab_size),
+                         self.config.dtype)
+        for ci in range(n_chunks):
+            chunk = jnp.asarray(ids[:, ci * c:(ci + 1) * c])
+            last, caches = self._chunk_prefill(self.params, chunk,
+                                               lengths_j, caches, last)
+        # per-row decode positions take over from the scalar chunk index
+        caches = [(kc, vc, lengths_j) for (kc, vc, _i) in caches]
+        return last, caches
 
     def _bucket_len(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -167,15 +219,20 @@ class Generator:
         assert s_max + cfg.max_new_tokens <= self.config.seq_len, (
             f"prompt {s_max} + max_new_tokens {cfg.max_new_tokens} "
             f"exceeds seq_len {self.config.seq_len}")
-        bucket = self._bucket_len(s_max)
-        ids = np.zeros((b, bucket), np.int32)
-        for i, p in enumerate(prompts):
-            ids[i, :len(p)] = p
-
-        caches = init_kv_caches(self.config, b)
         lengths_j = jnp.asarray(lengths)
-        logits, caches = self._prefill(self.params, jnp.asarray(ids),
-                                       caches, lengths_j)
+        if self.prefill_chunk:
+            # no bucket ladder in chunked mode: any length up to the KV
+            # capacity streams through the one compiled chunk step
+            logits, caches = self._run_chunked_prefill(
+                prompts, lengths_j, b)
+        else:
+            bucket = self._bucket_len(s_max)
+            ids = np.zeros((b, bucket), np.int32)
+            for i, p in enumerate(prompts):
+                ids[i, :len(p)] = p
+            caches = init_kv_caches(self.config, b)
+            logits, caches = self._prefill(self.params, jnp.asarray(ids),
+                                           caches, lengths_j)
         generated = []
         finished = jnp.zeros((b,), bool)
         index = lengths_j

@@ -84,6 +84,60 @@ class TestShapeBucketing:
         np.testing.assert_array_equal(mixed[1], solo2[0])
 
 
+class TestChunkedPrefill:
+    """One compiled step serves EVERY prompt length (the long-context
+    serving mode; no bucket ladder)."""
+
+    def test_matches_bucketed_prefill(self):
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=64, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        plain = Generator(model, params, cfg, prompt_buckets=[32])
+        chunked = Generator(model, params, cfg, prompt_buckets=[32],
+                            prefill_chunk=8)
+        rng = np.random.RandomState(0)
+        for n in (3, 8, 11, 21, 29):
+            prompt = rng.randint(0, 64, (1, n)).astype(np.int32)
+            g1 = plain.generate(prompt, GenerationConfig(max_new_tokens=5))
+            g2 = chunked.generate(prompt,
+                                  GenerationConfig(max_new_tokens=5))
+            np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+        # the point: five different prompt lengths, ONE chunk compile
+        assert chunked.prefill_traces == 1
+        # and no bucket ceiling: a prompt past the largest bucket still
+        # serves (chunks stream to KV capacity)
+        long_p = rng.randint(0, 64, (1, 40)).astype(np.int32)
+        out = chunked.generate(long_p, GenerationConfig(max_new_tokens=4))
+        assert np.asarray(out).shape == (1, 44)
+        assert chunked.prefill_traces == 1
+
+    def test_mixed_length_batch(self):
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=64, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        plain = Generator(model, params, cfg, prompt_buckets=[32])
+        chunked = Generator(model, params, cfg, prompt_buckets=[32],
+                            prefill_chunk=8)
+        prompts = [np.array([1, 2, 3], np.int32),
+                   np.array([7, 8, 9, 1, 2, 3, 4, 5, 6, 7, 11],
+                            np.int32)]
+        g1 = plain.generate(prompts, GenerationConfig(max_new_tokens=4))
+        g2 = chunked.generate(prompts, GenerationConfig(max_new_tokens=4))
+        for a, b in zip(g1, g2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_capacity_guard(self):
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=16, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        chunked = Generator(model, params, cfg, prompt_buckets=[16],
+                            prefill_chunk=10)
+        # 12 tokens pad to 2 chunks x 10 = 20 > seq_len 16
+        with pytest.raises(AssertionError, match="KV capacity"):
+            chunked.generate(np.arange(12, dtype=np.int32)[None],
+                             GenerationConfig(max_new_tokens=2))
+
+
 class TestRequestBatching:
 
     def test_concurrent_requests_share_batches(self):
