@@ -167,10 +167,14 @@ class Generator:
         c = self.prefill_chunk
         s_max = int(max(len(p) for p in prompts))
         n_chunks = max(1, -(-s_max // c))
-        assert n_chunks * c <= self.config.seq_len, (
-            f"chunked prefill of {s_max} tokens pads to {n_chunks * c}, "
-            f"exceeding the KV capacity (seq_len {self.config.seq_len}); "
-            f"use a chunk size dividing seq_len or a shorter prompt")
+        if n_chunks * c > self.config.seq_len:
+            # hard error (not assert): under -O a clamped cache write
+            # would silently corrupt earlier tokens' K/V
+            raise ValueError(
+                f"chunked prefill of {s_max} tokens pads to "
+                f"{n_chunks * c}, exceeding the KV capacity (seq_len "
+                f"{self.config.seq_len}); use a chunk size dividing "
+                f"seq_len or a shorter prompt")
         ids = np.zeros((b, n_chunks * c), np.int32)
         for i, p in enumerate(prompts):
             ids[i, :len(p)] = p
@@ -288,9 +292,16 @@ class Generator:
 
         # Prefill ONCE (B=1), then broadcast logits + caches across the
         # beam axis — K-times cheaper than prefilling identical copies.
-        caches1 = init_kv_caches(self.config, 1)
-        logits1, caches1 = self._prefill(self.params, input_ids, caches1,
-                                         jnp.full((1,), s, jnp.int32))
+        # Chunked mode keeps its one-compile contract here too.
+        if self.prefill_chunk:
+            logits1, caches1 = self._run_chunked_prefill(
+                [np.asarray(input_ids[0])],
+                jnp.full((1,), s, jnp.int32), 1)
+        else:
+            caches1 = init_kv_caches(self.config, 1)
+            logits1, caches1 = self._prefill(self.params, input_ids,
+                                             caches1,
+                                             jnp.full((1,), s, jnp.int32))
         beams = jnp.repeat(input_ids, num_beams, axis=0)     # (K, S)
         logits = jnp.repeat(logits1, num_beams, axis=0)
         caches = jax.tree_util.tree_map(

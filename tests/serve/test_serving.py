@@ -132,10 +132,27 @@ class TestChunkedPrefill:
         model, params = init_gpt_real(cfg, 1)
         chunked = Generator(model, params, cfg, prompt_buckets=[16],
                             prefill_chunk=10)
-        # 12 tokens pad to 2 chunks x 10 = 20 > seq_len 16
-        with pytest.raises(AssertionError, match="KV capacity"):
+        # 12 tokens pad to 2 chunks x 10 = 20 > seq_len 16; hard error
+        # (survives python -O, where a clamped write would corrupt)
+        with pytest.raises(ValueError, match="KV capacity"):
             chunked.generate(np.arange(12, dtype=np.int32)[None],
                              GenerationConfig(max_new_tokens=2))
+
+    def test_beam_search_uses_chunked_prefill(self):
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=64, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        plain = Generator(model, params, cfg, prompt_buckets=[32])
+        chunked = Generator(model, params, cfg, prompt_buckets=[32],
+                            prefill_chunk=8)
+        rng = np.random.RandomState(3)
+        for n in (5, 13):
+            p = rng.randint(0, 64, (1, n)).astype(np.int32)
+            b1 = plain.generate_beam(p, num_beams=3, max_new_tokens=5)
+            b2 = chunked.generate_beam(p, num_beams=3, max_new_tokens=5)
+            np.testing.assert_array_equal(np.asarray(b1), np.asarray(b2))
+        # both beam prompts rode the single chunk compile
+        assert chunked.prefill_traces == 1
 
 
 class TestRequestBatching:
