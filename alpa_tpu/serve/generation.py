@@ -25,6 +25,22 @@ logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
+class PrefixHandle:
+    """Precomputed KV for a shared prompt prefix (system prompt caching):
+    B=1 caches holding ``length`` tokens at scalar write index
+    ``length``.  Created by ``Generator.cache_prefix``; consumed by
+    ``generate(..., prefix=handle)``, which broadcasts the K/V across
+    the batch and prefills only each request's suffix.  ``last_logits``
+    are the prefix's final-token logits, so empty suffixes generate
+    straight from the cached prompt.  ``params`` is a strong reference
+    used for identity guarding (a raw id() could collide after GC)."""
+    caches: Any
+    length: int
+    last_logits: Any
+    params: Any = dataclasses.field(repr=False, default=None)
+
+
+@dataclasses.dataclass
 class GenerationConfig:
     max_new_tokens: int = 32
     temperature: float = 1.0
@@ -161,26 +177,37 @@ class Generator:
                 lambda x: jnp.take(x, idx, axis=0)
                 if hasattr(x, "ndim") and x.ndim > 0 else x, caches))
 
-    def _run_chunked_prefill(self, prompts, lengths_j, b):
+    def _run_chunked_prefill(self, prompts, lengths_j, b, caches=None,
+                             start=0, init_last=None):
         """Stream the prompts through the fixed-shape chunk step: one
-        compile covers every prompt length."""
+        compile covers every prompt length.
+
+        ``caches``/``start``: continue from precomputed K/V (prefix
+        caching) — ``prompts`` are then suffixes written from position
+        ``start``, and ``lengths_j`` are TOTAL lengths (prefix+suffix).
+        ``init_last`` seeds the final-logit accumulator (the prefix's
+        last-token logits, so empty suffixes keep them).
+        """
         c = self.prefill_chunk
         s_max = int(max(len(p) for p in prompts))
         n_chunks = max(1, -(-s_max // c))
-        if n_chunks * c > self.config.seq_len:
+        if start + n_chunks * c > self.config.seq_len:
             # hard error (not assert): under -O a clamped cache write
             # would silently corrupt earlier tokens' K/V
             raise ValueError(
-                f"chunked prefill of {s_max} tokens pads to "
-                f"{n_chunks * c}, exceeding the KV capacity (seq_len "
-                f"{self.config.seq_len}); use a chunk size dividing "
-                f"seq_len or a shorter prompt")
+                f"chunked prefill of {s_max} tokens at offset {start} "
+                f"pads to {start + n_chunks * c}, exceeding the KV "
+                f"capacity (seq_len {self.config.seq_len}); use a chunk "
+                f"size dividing seq_len or a shorter prompt")
         ids = np.zeros((b, n_chunks * c), np.int32)
         for i, p in enumerate(prompts):
             ids[i, :len(p)] = p
-        caches = init_kv_caches(self.config, b)   # scalar index 0
-        last = jnp.zeros((b, self.config.vocab_size),
-                         self.config.dtype)
+        if caches is None:
+            caches = init_kv_caches(self.config, b)   # scalar index 0
+        if init_last is None:
+            init_last = jnp.zeros((b, self.config.vocab_size),
+                                  self.config.dtype)
+        last = init_last
         for ci in range(n_chunks):
             chunk = jnp.asarray(ids[:, ci * c:(ci + 1) * c])
             last, caches = self._chunk_prefill(self.params, chunk,
@@ -188,6 +215,21 @@ class Generator:
         # per-row decode positions take over from the scalar chunk index
         caches = [(kc, vc, lengths_j) for (kc, vc, _i) in caches]
         return last, caches
+
+    def cache_prefix(self, prefix_ids) -> "PrefixHandle":
+        """Precompute KV for a shared prefix (system prompt caching).
+        Chunked mode only — the chunk step is what lets suffixes resume
+        at an arbitrary cache offset with one compile."""
+        if not self.prefill_chunk:
+            raise ValueError(
+                "cache_prefix requires Generator(prefill_chunk=...)")
+        p = np.asarray(prefix_ids, np.int32).reshape(-1)
+        lengths = jnp.asarray([len(p)], jnp.int32)
+        last, caches = self._run_chunked_prefill([p], lengths, 1)
+        # restore the SCALAR index (suffix chunks continue from here)
+        caches = [(kc, vc, jnp.int32(len(p))) for (kc, vc, _i) in caches]
+        return PrefixHandle(caches=caches, length=len(p),
+                            last_logits=last, params=self.params)
 
     def _bucket_len(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -199,13 +241,20 @@ class Generator:
     def generate(self,
                  input_ids,
                  generation_config: Optional[GenerationConfig] = None,
-                 rng: Optional[jax.Array] = None) -> List[np.ndarray]:
+                 rng: Optional[jax.Array] = None,
+                 prefix: Optional["PrefixHandle"] = None
+                 ) -> List[np.ndarray]:
         """Generate for a batch of (possibly mixed-length) prompts.
 
         ``input_ids``: (B, S) array, or a list of 1-D prompts of varying
         lengths.  Uniform-length batches return a (B, S + T) array with
         finished rows eos-padded; mixed-length batches return a list of B
         1-D arrays (prompt + generation, truncated at eos).
+
+        ``prefix``: a ``cache_prefix`` handle — the prefix's KV is
+        broadcast across the batch and only each request's SUFFIX
+        (``input_ids``) is prefilled; returned rows contain suffix +
+        generation (the caller already has the prefix tokens).
         """
         cfg = generation_config or GenerationConfig()
         rng = rng if rng is not None else jax.random.PRNGKey(0)
@@ -218,17 +267,40 @@ class Generator:
                 arr = arr[None]
             prompts = list(arr)
         b = len(prompts)
-        lengths = np.array([len(p) for p in prompts], np.int32)
+        plen = 0
+        if prefix is not None:
+            if not self.prefill_chunk:
+                raise ValueError("prefix caching requires "
+                                 "Generator(prefill_chunk=...)")
+            if prefix.params is not self.params:
+                raise ValueError("PrefixHandle was built for different "
+                                 "params")
+            plen = prefix.length
+        lengths = np.array([plen + len(p) for p in prompts], np.int32)
         s_max = int(lengths.max())
-        assert s_max + cfg.max_new_tokens <= self.config.seq_len, (
-            f"prompt {s_max} + max_new_tokens {cfg.max_new_tokens} "
-            f"exceeds seq_len {self.config.seq_len}")
+        if s_max + cfg.max_new_tokens > self.config.seq_len:
+            # hard error: under -O a stripped assert would let decode
+            # write past the cache and silently corrupt the last entry
+            raise ValueError(
+                f"prompt {s_max} + max_new_tokens {cfg.max_new_tokens} "
+                f"exceeds seq_len {self.config.seq_len}")
         lengths_j = jnp.asarray(lengths)
         if self.prefill_chunk:
             # no bucket ladder in chunked mode: any length up to the KV
             # capacity streams through the one compiled chunk step
+            init = None
+            init_last = None
+            if prefix is not None:
+                # broadcast the prefix K/V across the batch; the scalar
+                # write index (== plen) rides along, and the prefix's
+                # last logits seed rows whose suffix is empty
+                init = [(jnp.repeat(kc, b, axis=0),
+                         jnp.repeat(vc, b, axis=0), idx)
+                        for (kc, vc, idx) in prefix.caches]
+                init_last = jnp.repeat(prefix.last_logits, b, axis=0)
             logits, caches = self._run_chunked_prefill(
-                prompts, lengths_j, b)
+                prompts, lengths_j, b, caches=init, start=plen,
+                init_last=init_last)
         else:
             bucket = self._bucket_len(s_max)
             ids = np.zeros((b, bucket), np.int32)
@@ -288,7 +360,10 @@ class Generator:
             input_ids = input_ids[None]
         assert input_ids.shape[0] == 1, "beam search takes one prompt"
         s = input_ids.shape[1]
-        assert s + max_new_tokens <= self.config.seq_len
+        if s + max_new_tokens > self.config.seq_len:
+            raise ValueError(
+                f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+                f"seq_len {self.config.seq_len}")
 
         # Prefill ONCE (B=1), then broadcast logits + caches across the
         # beam axis — K-times cheaper than prefilling identical copies.

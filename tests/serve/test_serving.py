@@ -138,6 +138,66 @@ class TestChunkedPrefill:
             chunked.generate(np.arange(12, dtype=np.int32)[None],
                              GenerationConfig(max_new_tokens=2))
 
+    def test_prefix_caching_matches_full_prompt(self):
+        """System-prompt caching: prefix KV computed once, suffixes ride
+        it — generations identical to prefilling prefix+suffix whole."""
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=64, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        gen = Generator(model, params, cfg, prompt_buckets=[48],
+                        prefill_chunk=8)
+        rng = np.random.RandomState(4)
+        prefix = rng.randint(0, 64, (21,)).astype(np.int32)
+        handle = gen.cache_prefix(prefix)
+        assert handle.length == 21
+        for n in (1, 4, 9):
+            suffix = rng.randint(0, 64, (1, n)).astype(np.int32)
+            want = gen.generate(
+                np.concatenate([prefix[None], suffix], axis=1),
+                GenerationConfig(max_new_tokens=5))
+            got = gen.generate(suffix, GenerationConfig(max_new_tokens=5),
+                               prefix=handle)
+            # got rows are suffix + generation (caller holds the prefix)
+            np.testing.assert_array_equal(
+                np.concatenate([prefix[None], np.asarray(got)], axis=1),
+                np.asarray(want))
+        # EMPTY suffix: generate straight from the cached prompt (the
+        # handle carries the prefix's last-token logits)
+        want = gen.generate(prefix[None], GenerationConfig(max_new_tokens=5))
+        got = gen.generate([np.zeros((0,), np.int32)],
+                           GenerationConfig(max_new_tokens=5),
+                           prefix=handle)
+        np.testing.assert_array_equal(np.concatenate([prefix, got[0]]),
+                                      np.asarray(want)[0])
+        # mixed-length batch over the same prefix
+        sfx = [rng.randint(0, 64, (3,)).astype(np.int32),
+               rng.randint(0, 64, (7,)).astype(np.int32)]
+        got = gen.generate(sfx, GenerationConfig(max_new_tokens=4),
+                           prefix=handle)
+        for s, g in zip(sfx, got):
+            want = gen.generate(np.concatenate([prefix, s])[None],
+                                GenerationConfig(max_new_tokens=4))
+            np.testing.assert_array_equal(np.concatenate([prefix, g]),
+                                          np.asarray(want)[0])
+
+    def test_prefix_handle_guards(self):
+        cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                        seq_len=32, vocab_size=64)
+        model, params = init_gpt_real(cfg, 1)
+        bucketed = Generator(model, params, cfg, prompt_buckets=[16])
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            bucketed.cache_prefix(np.arange(4, dtype=np.int32))
+        chunked = Generator(model, params, cfg, prompt_buckets=[16],
+                            prefill_chunk=8)
+        handle = chunked.cache_prefix(np.arange(4, dtype=np.int32))
+        model2, params2 = init_gpt_real(cfg, 1)
+        other = Generator(model2, params2, cfg, prompt_buckets=[16],
+                          prefill_chunk=8)
+        with pytest.raises(ValueError, match="different"):
+            other.generate(np.array([[1]], np.int32),
+                           GenerationConfig(max_new_tokens=1),
+                           prefix=handle)
+
     def test_beam_search_uses_chunked_prefill(self):
         cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
                         seq_len=64, vocab_size=64)
