@@ -142,6 +142,7 @@ class Generator:
             return logits[:, 0, :], caches
 
         self.prefill_chunk = prefill_chunk
+        self._parallel_method = parallel_method
 
         def chunk_prefill(params, ids_chunk, lengths, caches, last):
             """One fixed-shape chunk through the cached path.  The
@@ -177,6 +178,18 @@ class Generator:
                 lambda x: jnp.take(x, idx, axis=0)
                 if hasattr(x, "ndim") and x.ndim > 0 else x, caches))
 
+    def _run_bucketed_prefill(self, prompts, lengths_j, b):
+        """Classic bucketed prefill: right-pad to the bucket ladder (one
+        compile per bucket).  The single shared implementation for
+        generate and speculative decoding."""
+        bucket = self._bucket_len(int(max(len(p) for p in prompts)))
+        ids = np.zeros((b, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            ids[i, :len(p)] = p
+        caches = init_kv_caches(self.config, b)
+        return self._prefill(self.params, jnp.asarray(ids), caches,
+                             lengths_j)
+
     def _run_chunked_prefill(self, prompts, lengths_j, b, caches=None,
                              start=0, init_last=None):
         """Stream the prompts through the fixed-shape chunk step: one
@@ -190,6 +203,11 @@ class Generator:
         """
         c = self.prefill_chunk
         s_max = int(max(len(p) for p in prompts))
+        if s_max == 0 and caches is not None:
+            # all suffixes empty: nothing to prefill — the prefix's
+            # last_logits (init_last) already seed decode
+            caches = [(kc, vc, lengths_j) for (kc, vc, _i) in caches]
+            return init_last, caches
         n_chunks = max(1, -(-s_max // c))
         if start + n_chunks * c > self.config.seq_len:
             # hard error (not assert): under -O a clamped cache write
@@ -302,13 +320,8 @@ class Generator:
                 prompts, lengths_j, b, caches=init, start=plen,
                 init_last=init_last)
         else:
-            bucket = self._bucket_len(s_max)
-            ids = np.zeros((b, bucket), np.int32)
-            for i, p in enumerate(prompts):
-                ids[i, :len(p)] = p
-            caches = init_kv_caches(self.config, b)
-            logits, caches = self._prefill(self.params, jnp.asarray(ids),
-                                           caches, lengths_j)
+            logits, caches = self._run_bucketed_prefill(prompts, lengths_j,
+                                                        b)
         generated = []
         finished = jnp.zeros((b,), bool)
         index = lengths_j
@@ -341,6 +354,152 @@ class Generator:
             outs.append(np.concatenate([p, row]))
         return outs
 
+
+    def generate_speculative(self,
+                             draft: "Generator",
+                             input_ids,
+                             generation_config: Optional[
+                                 GenerationConfig] = None,
+                             num_draft: int = 4):
+        """Greedy speculative decoding: ``draft`` (a small Generator over
+        the same tokenizer) proposes ``num_draft`` tokens per round; this
+        (target) model verifies them in ONE cached forward and accepts
+        the agreeing prefix plus its own next token.
+
+        Exactness: greedy speculative decoding provably emits the same
+        sequence as plain greedy decoding of the target — the draft only
+        changes how many target forwards it takes.  Cache rollback after
+        a rejection is free under the cache-as-invars design: garbage
+        K/V beyond the write index is masked, so rollback is just
+        resetting the index.  Returns (output_row, stats) where stats
+        has ``rounds`` / ``proposed`` / ``accepted``.
+        """
+        cfg = generation_config or GenerationConfig()
+        if cfg.do_sample:
+            raise ValueError("speculative decoding here is greedy; "
+                             "do_sample is not supported")
+        prompt = np.asarray(input_ids, np.int32).reshape(-1)
+        k = int(num_draft)
+        if k < 1:
+            raise ValueError(f"num_draft must be >= 1, got {k}")
+        if len(prompt) + cfg.max_new_tokens > self.config.seq_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens "
+                f"{cfg.max_new_tokens} exceeds seq_len "
+                f"{self.config.seq_len}")
+        if len(prompt) + cfg.max_new_tokens > draft.config.seq_len:
+            # a too-small draft cache would overrun silently: proposals
+            # degrade to garbage and acceptance collapses with no error
+            raise ValueError(
+                f"draft seq_len {draft.config.seq_len} cannot hold "
+                f"prompt {len(prompt)} + max_new_tokens "
+                f"{cfg.max_new_tokens}")
+
+        t_logits, t_caches = self._spec_prefill(self, prompt)
+        d_logits, d_caches = self._spec_prefill(draft, prompt)
+        del d_logits
+
+        pending = int(np.argmax(np.asarray(t_logits)[0]))
+        generated = [pending]
+        stats = {"rounds": 0, "proposed": 0, "accepted": 0}
+        eos = cfg.eos_token_id
+        while len(generated) < cfg.max_new_tokens and \
+                (eos is None or pending != eos):
+            # shrink the round near the KV capacity so the verify write
+            # (k_r + 1 tokens incl. a bonus slot) always fits — greedy
+            # exactness must hold all the way to the cache edge
+            idx = int(np.asarray(t_caches[0][2])[0])
+            cap = min(self.config.seq_len, draft.config.seq_len)
+            k_r = min(k, cap - idx - 1,
+                      cfg.max_new_tokens - len(generated))
+            if k_r < 1:
+                # no room for a proposal round: plain single decode
+                t_logits, t_caches = self._decode(
+                    self.params, jnp.asarray([[pending]], jnp.int32),
+                    t_caches[0][2], t_caches)
+                pending = int(np.argmax(np.asarray(t_logits)[0]))
+                generated.append(pending)
+                continue
+            # draft proposes k_r tokens (k_r+1 decodes: the last feed
+            # keeps the draft cache in lockstep with the verify write)
+            props = []
+            tok = pending
+            for _ in range(k_r):
+                d_logits, d_caches = draft._decode(
+                    draft.params, jnp.asarray([[tok]], jnp.int32),
+                    d_caches[0][2], d_caches)
+                tok = int(np.argmax(np.asarray(d_logits)[0]))
+                props.append(tok)
+            _discard, d_caches = draft._decode(
+                draft.params, jnp.asarray([[props[-1]]], jnp.int32),
+                d_caches[0][2], d_caches)
+
+            # target verifies [pending, p1..p_{k_r}] in one forward
+            verify = self._get_verify_step(k_r + 1)
+            toks = jnp.asarray([[pending] + props], jnp.int32)
+            v_logits, t_caches = verify(self.params, toks,
+                                        t_caches[0][2], t_caches)
+            t_preds = np.argmax(np.asarray(v_logits)[0], axis=-1)
+            a = 0
+            while a < k_r and t_preds[a] == props[a]:
+                a += 1
+            emitted = props[:a] + [int(t_preds[a] if a < k_r
+                                       else t_preds[k_r])]
+            stats["rounds"] += 1
+            stats["proposed"] += k_r
+            stats["accepted"] += a
+
+            # rollback: confirmed this round = pending + a proposals
+            conf = 1 + a
+            t_caches = [(kc, vc, idx2 - (k_r + 1) + conf)
+                        for (kc, vc, idx2) in t_caches]
+            d_caches = [(kc, vc, idx2 - (k_r + 1) + conf)
+                        for (kc, vc, idx2) in d_caches]
+            for t in emitted:
+                generated.append(t)
+                if eos is not None and t == eos:
+                    break
+            pending = generated[-1]
+
+        gen = np.asarray(generated[:cfg.max_new_tokens], np.int32)
+        if eos is not None:
+            hits = np.nonzero(gen == eos)[0]
+            if hits.size:
+                gen = gen[:hits[0] + 1]
+        return np.concatenate([prompt, gen]), stats
+
+    @staticmethod
+    def _spec_prefill(gen: "Generator", prompt):
+        lengths = jnp.asarray([len(prompt)], jnp.int32)
+        if gen.prefill_chunk:
+            return gen._run_chunked_prefill([prompt], lengths, 1)
+        return gen._run_bucketed_prefill([prompt], lengths, 1)
+
+    def _get_verify_step(self, s: int):
+        """Compiled multi-token cached forward (the verify leg): writes
+        ``s`` tokens at the per-row index and returns all logits.
+        Compiled through the Generator's parallel method when one is set
+        (same placement as prefill/decode — the caches stay sharded)."""
+        cached = getattr(self, "_verify_steps", None)
+        if cached is None:
+            cached = self._verify_steps = {}
+        if s not in cached:
+            model = self.model
+
+            def verify(params, toks, index, caches):
+                b, sl = toks.shape
+                pos = index[:, None] + jax.lax.broadcasted_iota(
+                    jnp.int32, (b, sl), 1)
+                return model.apply(params, toks, pos, caches)
+
+            if self._parallel_method is not None:
+                import alpa_tpu
+                cached[s] = alpa_tpu.parallelize(
+                    verify, method=self._parallel_method,
+                    donate_argnums=())
+            else:
+                cached[s] = jax.jit(verify)
+        return cached[s]
 
     def generate_beam(self,
                       input_ids: np.ndarray,

@@ -381,6 +381,91 @@ if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
 
 
+class TestSpeculativeDecoding:
+    """Greedy speculative decoding is EXACT: same tokens as plain greedy
+    on the target, fewer target forwards."""
+
+    def _models(self):
+        cfg_t = GPTConfig(hidden_size=48, num_layers=3, num_heads=4,
+                          seq_len=64, vocab_size=64)
+        model_t, params_t = init_gpt_real(cfg_t, 1)
+        target = Generator(model_t, params_t, cfg_t, prompt_buckets=[16])
+        cfg_d = GPTConfig(hidden_size=16, num_layers=1, num_heads=2,
+                          seq_len=64, vocab_size=64)
+        model_d, params_d = init_gpt_real(cfg_d, 1)
+        draft = Generator(model_d, params_d, cfg_d, prompt_buckets=[16])
+        return target, draft
+
+    def test_exactly_matches_plain_greedy(self):
+        target, draft = self._models()
+        prompt = np.random.RandomState(5).randint(0, 64, (9,)) \
+            .astype(np.int32)
+        want = target.generate(prompt[None],
+                               GenerationConfig(max_new_tokens=12))
+        got, stats = target.generate_speculative(
+            draft, prompt, GenerationConfig(max_new_tokens=12),
+            num_draft=3)
+        np.testing.assert_array_equal(got, np.asarray(want)[0])
+        assert stats["rounds"] >= 1
+        assert 0 <= stats["accepted"] <= stats["proposed"]
+
+    def test_self_draft_accepts_everything(self):
+        """Draft == target: every proposal must be accepted (the
+        verification logic agrees with itself)."""
+        target, _ = self._models()
+        prompt = np.array([3, 1, 4, 1, 5], np.int32)
+        got, stats = target.generate_speculative(
+            target, prompt, GenerationConfig(max_new_tokens=10),
+            num_draft=4)
+        want = target.generate(prompt[None],
+                               GenerationConfig(max_new_tokens=10))
+        np.testing.assert_array_equal(got, np.asarray(want)[0])
+        assert stats["accepted"] == stats["proposed"]
+
+    def test_exact_up_to_kv_capacity(self):
+        """Near the cache edge the round shrinks (and falls back to
+        single decodes) instead of silently under-generating."""
+        cfg_t = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                          seq_len=32, vocab_size=64)
+        model_t, params_t = init_gpt_real(cfg_t, 1)
+        target = Generator(model_t, params_t, cfg_t, prompt_buckets=[32])
+        prompt = np.random.RandomState(6).randint(0, 64, (18,)) \
+            .astype(np.int32)
+        # 18 + 14 == seq_len exactly; num_draft=5 must shrink at the edge
+        want = target.generate(prompt[None],
+                               GenerationConfig(max_new_tokens=14))
+        got, stats = target.generate_speculative(
+            target, prompt, GenerationConfig(max_new_tokens=14),
+            num_draft=5)
+        np.testing.assert_array_equal(got, np.asarray(want)[0])
+        assert len(got) == 32  # full budget emitted
+
+    def test_undersized_draft_rejected(self):
+        target, _ = self._models()
+        cfg_d = GPTConfig(hidden_size=16, num_layers=1, num_heads=2,
+                          seq_len=8, vocab_size=64)
+        model_d, params_d = init_gpt_real(cfg_d, 1)
+        draft = Generator(model_d, params_d, cfg_d, prompt_buckets=[8])
+        with pytest.raises(ValueError, match="draft seq_len"):
+            target.generate_speculative(
+                draft, np.arange(6, dtype=np.int32),
+                GenerationConfig(max_new_tokens=8), num_draft=2)
+
+    def test_eos_stops_early(self):
+        target, draft = self._models()
+        prompt = np.array([1, 2], np.int32)
+        plain = target.generate(prompt[None],
+                                GenerationConfig(max_new_tokens=10))
+        eos = int(np.asarray(plain)[0, 4])  # force an early stop
+        want = target.generate(prompt[None], GenerationConfig(
+            max_new_tokens=10, eos_token_id=eos))
+        got, _ = target.generate_speculative(
+            draft, prompt, GenerationConfig(max_new_tokens=10,
+                                            eos_token_id=eos),
+            num_draft=3)
+        np.testing.assert_array_equal(got, np.asarray(want)[0])
+
+
 class TestBeamSearch:
 
     def test_beam_width_one_equals_greedy(self):
