@@ -51,16 +51,38 @@ class ContinuousBatchingEngine:
     def __init__(self, generator: Generator, max_batch: int = 4,
                  prompt_bucket: Optional[int] = None,
                  packed_admission: bool = False,
-                 packed_bucket: Optional[int] = None):
+                 packed_bucket: Optional[int] = None,
+                 prefix: Optional[Any] = None):
         """``packed_admission=True`` admits multiple queued prompts with
         ONE packed prefill (segment-masked, serve.packed.PackedPrefill —
         the 1-D batching analog) instead of one prefill per row; falls
         back to per-row prefill when fewer than two prompts wait or the
-        backlog exceeds ``packed_bucket`` total tokens."""
+        backlog exceeds ``packed_bucket`` total tokens.
+
+        ``prefix``: a ``Generator.cache_prefix`` handle shared by EVERY
+        request (system prompt): each admission prefills only its
+        suffix over a copy of the prefix K/V.  Requires the generator's
+        chunked-prefill mode; mutually exclusive with packed admission
+        (pads in a pack cannot share the prefix attention region)."""
         self.gen = generator
         self.B = max_batch
         self.bucket = prompt_bucket or generator.prompt_buckets[0]
         cfgm = generator.config
+        self._prefix = prefix
+        if prefix is not None:
+            if not generator.prefill_chunk:
+                raise ValueError(
+                    "engine prefix caching requires "
+                    "Generator(prefill_chunk=...)")
+            if packed_admission:
+                raise ValueError(
+                    "prefix caching and packed admission are mutually "
+                    "exclusive")
+            if getattr(prefix, "params", None) is not generator.params:
+                # same guard Generator.generate enforces: a stale handle
+                # would serve plausible-but-wrong tokens silently
+                raise ValueError(
+                    "PrefixHandle was built for different params")
         self._packed = None
         if packed_admission:
             # packing needs segment-mask support AND position-id-based
@@ -177,13 +199,29 @@ class ContinuousBatchingEngine:
     def _make_item(self, prompt, cfg, on_token, on_done=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = cfg or GenerationConfig()
-        assert len(prompt) <= self.bucket, (
-            f"prompt {len(prompt)} exceeds engine bucket {self.bucket}")
-        assert len(prompt) + cfg.max_new_tokens <= \
-            self.gen.config.seq_len, (
-                f"prompt {len(prompt)} + max_new_tokens "
-                f"{cfg.max_new_tokens} exceeds seq_len "
-                f"{self.gen.config.seq_len}")
+        seq_len = self.gen.config.seq_len
+        plen = self._prefix.length if self._prefix is not None else 0
+        if len(prompt) > self.bucket:
+            raise ValueError(
+                f"prompt {len(prompt)} exceeds engine bucket "
+                f"{self.bucket}")
+        # hard errors (not asserts): -O must not admit a request whose
+        # decode would write past the cache
+        if plen + len(prompt) + cfg.max_new_tokens > seq_len:
+            raise ValueError(
+                f"prefix {plen} + prompt {len(prompt)} + max_new_tokens "
+                f"{cfg.max_new_tokens} exceeds seq_len {seq_len}")
+        if self._prefix is not None:
+            # admission prefills in fixed chunks FROM the prefix offset:
+            # reject synchronously what chunk padding cannot fit
+            c = self.gen.prefill_chunk
+            padded = max(1, -(-len(prompt) // c)) * c if len(prompt) \
+                else 0
+            if plen + padded > seq_len:
+                raise ValueError(
+                    f"prompt {len(prompt)} pads to {padded} chunks past "
+                    f"prefix {plen}, exceeding seq_len {seq_len}; use a "
+                    "smaller chunk size or shorter prompt")
         return {"prompt": prompt, "cfg": cfg, "tokens": [],
                 "done": _DoneEvent(on_done), "error": None,
                 "on_token": on_token, "cancelled": False}
@@ -248,12 +286,23 @@ class ContinuousBatchingEngine:
             item = self._queue.pop(0)
             try:
                 p = item["prompt"]
-                ids = np.zeros((1, self.bucket), np.int32)
-                ids[0, :len(p)] = p
-                caches1 = init_kv_caches(self.gen.config, 1)
-                logits1, caches1 = self.gen._prefill(
-                    self.gen.params, jnp.asarray(ids), caches1,
-                    jnp.asarray([len(p)], jnp.int32))
+                if self._prefix is not None:
+                    # suffix-only prefill OVER the shared prefix K/V.
+                    # The handle's arrays are shared read-only: the
+                    # chunk step is functional and non-donating, so the
+                    # handle survives every admission unchanged.
+                    h = self._prefix
+                    total = jnp.asarray([h.length + len(p)], jnp.int32)
+                    logits1, caches1 = self.gen._run_chunked_prefill(
+                        [p], total, 1, caches=h.caches, start=h.length,
+                        init_last=h.last_logits)
+                else:
+                    ids = np.zeros((1, self.bucket), np.int32)
+                    ids[0, :len(p)] = p
+                    caches1 = init_kv_caches(self.gen.config, 1)
+                    logits1, caches1 = self.gen._prefill(
+                        self.gen.params, jnp.asarray(ids), caches1,
+                        jnp.asarray([len(p)], jnp.int32))
                 self._caches, self._logits = self._scatter_row(
                     self._caches, caches1, self._logits,
                     logits1.astype(jnp.float32), r)
