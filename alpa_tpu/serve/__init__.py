@@ -5,8 +5,10 @@ Analog of ref ``alpa/serve/`` + ``examples/llm_serving`` (SURVEY.md §2.8,
 autoregressive generation engine with resident KV caches compiled per
 (batch, length-bucket).
 """
-from alpa_tpu.serve.generation import GenerationConfig, Generator, get_model
+from alpa_tpu.serve.generation import (GenerationConfig, Generator,
+                                       PrefixHandle, get_model)
 from alpa_tpu.serve.controller import (Controller, RequestBatcher,
                                        run_controller)
 from alpa_tpu.serve.engine import ContinuousBatchingEngine
 from alpa_tpu.serve.hf_wrapper import WrappedInferenceModel, get_hf_model
+from alpa_tpu.serve.packed import PackedPrefill, pack_prompts
