@@ -39,10 +39,13 @@ class RequestBatcher:
     batch runs to the max, each request is truncated to its own)."""
 
     def __init__(self, generator: Generator, max_batch: int = 8,
-                 max_wait_ms: float = 2.0):
+                 max_wait_ms: float = 2.0, prefix=None):
         self.generator = generator
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        # shared system-prompt handle: prompts are suffixes over it, in
+        # BOTH the batched and streaming paths (same request semantics)
+        self.prefix = prefix
         self._queue: List[dict] = []
         self._cv = threading.Condition()
         self._thread = threading.Thread(target=self._run, daemon=True)
@@ -98,7 +101,8 @@ class RequestBatcher:
                     batch[0]["cfg"],
                     max_new_tokens=max(it["cfg"].max_new_tokens
                                        for it in batch))
-                outs = self.generator.generate(prompts, run_cfg)
+                outs = self.generator.generate(prompts, run_cfg,
+                                               prefix=self.prefix)
                 self.batches_run += 1
                 i = 0
                 for it in batch:
@@ -119,22 +123,26 @@ class RequestBatcher:
 
 class _Replica:
 
-    def __init__(self, generator: Generator):
+    def __init__(self, generator: Generator, prefix=None):
         self.generator = generator
-        self.batcher = RequestBatcher(generator)
+        self.batcher = RequestBatcher(generator, prefix=prefix)
+        self.prefix = prefix
         self._engine = None
         self._lock = threading.Lock()
 
     @property
     def engine(self):
         """Lazy continuous-batching engine for streaming requests (so
-        non-streaming deployments never spin its decode thread)."""
+        non-streaming deployments never spin its decode thread).  When
+        the model was registered with a prefix, every streamed request's
+        prompt_ids are a SUFFIX over that shared system prompt."""
         with self._lock:
             if self._engine is None:
                 from alpa_tpu.serve.engine import ContinuousBatchingEngine
                 self._engine = ContinuousBatchingEngine(
                     self.generator,
-                    prompt_bucket=self.generator.prompt_buckets[-1])
+                    prompt_bucket=self.generator.prompt_buckets[-1],
+                    prefix=self.prefix)
             return self._engine
 
 
@@ -144,14 +152,42 @@ class Controller:
     def __init__(self):
         self._models: Dict[str, List[_Replica]] = {}
         self._rr: Dict[str, int] = {}
+        self._prefix_ids: Dict[str, Any] = {}
         self._lock = threading.Lock()
 
-    def register_model(self, name: str, generator: Generator):
+    def register_model(self, name: str, generator: Generator,
+                       prefix_ids=None):
+        """``prefix_ids``: optional shared system prompt — its KV is
+        precomputed once (Generator.cache_prefix; requires the
+        generator's chunked-prefill mode) and every request to this
+        model (batched or streamed) sends only its suffix.  All
+        replicas of one model must register the SAME prefix: round-robin
+        dispatch must not change what prompt_ids mean."""
+        prefix_ids = (None if prefix_ids is None
+                      else np.asarray(prefix_ids, np.int32).reshape(-1))
         with self._lock:
-            self._models.setdefault(name, []).append(_Replica(generator))
+            if name in self._prefix_ids:
+                prev = self._prefix_ids[name]
+                same = ((prev is None and prefix_ids is None) or
+                        (prev is not None and prefix_ids is not None and
+                         np.array_equal(prev, prefix_ids)))
+                if not same:
+                    raise ValueError(
+                        f"model {name!r} replicas must share one "
+                        "prefix: an inconsistent replica would make "
+                        "identical requests mean different prompts")
+            else:
+                self._prefix_ids[name] = prefix_ids
+        prefix = None
+        if prefix_ids is not None:
+            prefix = generator.cache_prefix(prefix_ids)
+        with self._lock:
+            self._models.setdefault(name, []).append(
+                _Replica(generator, prefix=prefix))
             self._rr.setdefault(name, 0)
-        logger.info("registered model %s (%d replicas)", name,
-                    len(self._models[name]))
+        logger.info("registered model %s (%d replicas%s)", name,
+                    len(self._models[name]),
+                    f", prefix {prefix.length} tokens" if prefix else "")
 
     def list_models(self) -> List[str]:
         return sorted(self._models)

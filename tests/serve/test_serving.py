@@ -345,6 +345,54 @@ class TestController:
         finally:
             server.shutdown()
 
+    def test_http_streaming_with_registered_prefix(self):
+        """A model registered with a system prompt serves streamed
+        suffixes whose outputs equal whole-prompt greedy decoding."""
+        server = run_controller(port=0)
+        try:
+            cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                            seq_len=64, vocab_size=64)
+            model, params = init_gpt_real(cfg, 1)
+            gen = Generator(model, params, cfg, prompt_buckets=[32],
+                            prefill_chunk=8)
+            system = np.random.RandomState(7).randint(0, 64, (11,)) \
+                .astype(np.int32)
+            server.controller.register_model("sys", gen,
+                                             prefix_ids=system)
+            want = gen.generate(
+                np.concatenate([system, [5, 6, 7]])[None],
+                GenerationConfig(max_new_tokens=5))
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/completions",
+                data=json.dumps({"model": "sys", "prompt_ids": [5, 6, 7],
+                                 "max_new_tokens": 5,
+                                 "stream": True}).encode())
+            toks = []
+            with urllib.request.urlopen(req) as r:
+                for raw in r:
+                    line = raw.decode().strip()
+                    if line.startswith("data: "):
+                        ev = json.loads(line[6:])
+                        if "token" in ev:
+                            toks.append(ev["token"])
+            np.testing.assert_array_equal(
+                np.concatenate([system, [5, 6, 7], toks]),
+                np.asarray(want)[0])
+            # the NON-streaming path applies the same prefix semantics
+            req2 = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/completions",
+                data=json.dumps({"model": "sys", "prompt_ids": [5, 6, 7],
+                                 "max_new_tokens": 5}).encode())
+            with urllib.request.urlopen(req2) as r:
+                out = json.load(r)["output_ids"][0]
+            np.testing.assert_array_equal(
+                np.concatenate([system, out]), np.asarray(want)[0])
+            # replicas must share one prefix
+            with pytest.raises(ValueError, match="share one prefix"):
+                server.controller.register_model("sys", gen)
+        finally:
+            server.shutdown()
+
     def test_http_streaming(self):
         """SSE streaming: tokens arrive as individual events and the
         assembled row equals the non-streaming greedy result."""
