@@ -206,7 +206,18 @@ def main():
     parser.add_argument("--suite", required=True)
     parser.add_argument("--dump", default="benchmark_results.tsv")
     parser.add_argument("--niter", type=int, default=8)
+    parser.add_argument("--platform", default=None, choices=["cpu"],
+                        help="'cpu' pins a virtual CPU mesh (required "
+                        "for CPU runs on machines whose sitecustomize "
+                        "pins a TPU backend — env JAX_PLATFORMS alone "
+                        "is not honored there); omit to use whatever "
+                        "backend jax selects")
+    parser.add_argument("--cpu-devices", type=int, default=8)
     args = parser.parse_args()
+
+    if args.platform == "cpu":
+        from alpa_tpu.platform import pin_cpu_platform
+        pin_cpu_platform(args.cpu_devices)
 
     from benchmark.suites import suites
     from alpa_tpu.util import write_tsv
