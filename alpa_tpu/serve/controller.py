@@ -165,23 +165,35 @@ class Controller:
         dispatch must not change what prompt_ids mean."""
         prefix_ids = (None if prefix_ids is None
                       else np.asarray(prefix_ids, np.int32).reshape(-1))
+
+        def check_consistent():
+            prev = self._prefix_ids[name]
+            same = ((prev is None and prefix_ids is None) or
+                    (prev is not None and prefix_ids is not None and
+                     np.array_equal(prev, prefix_ids)))
+            if not same:
+                raise ValueError(
+                    f"model {name!r} replicas must share one "
+                    "prefix: an inconsistent replica would make "
+                    "identical requests mean different prompts")
+
+        # Validate first (no commit), run the possibly-slow/failing
+        # cache_prefix OUTSIDE the lock, then commit _prefix_ids and the
+        # replica append together — a cache_prefix failure must not pin
+        # the name to a prefix with zero replicas, and two concurrent
+        # registrations of the same name must both be checked against
+        # whatever actually got committed.
         with self._lock:
             if name in self._prefix_ids:
-                prev = self._prefix_ids[name]
-                same = ((prev is None and prefix_ids is None) or
-                        (prev is not None and prefix_ids is not None and
-                         np.array_equal(prev, prefix_ids)))
-                if not same:
-                    raise ValueError(
-                        f"model {name!r} replicas must share one "
-                        "prefix: an inconsistent replica would make "
-                        "identical requests mean different prompts")
-            else:
-                self._prefix_ids[name] = prefix_ids
+                check_consistent()
         prefix = None
         if prefix_ids is not None:
             prefix = generator.cache_prefix(prefix_ids)
         with self._lock:
+            if name in self._prefix_ids:
+                check_consistent()
+            else:
+                self._prefix_ids[name] = prefix_ids
             self._models.setdefault(name, []).append(
                 _Replica(generator, prefix=prefix))
             self._rr.setdefault(name, 0)

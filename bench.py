@@ -205,6 +205,14 @@ def _refuse_variants(errors) -> int:
 
 def main():
     import jax
+
+    # The axon sitecustomize force-registers the TPU relay platform and
+    # overrides the JAX_PLATFORMS env var; only the config-level pin
+    # actually keeps a CPU run off the relay (a wedged relay otherwise
+    # hangs even `jax.devices()` under JAX_PLATFORMS=cpu).
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
     import optax
 
@@ -227,8 +235,7 @@ def main():
     # scarce chip run while the result log claims the experiment ran
     opt_variant, ce_variant, shape_variant, bad = read_bench_variants()
     if bad:
-        _refuse_variants(bad)
-        return
+        sys.exit(_refuse_variants(bad))
 
     if on_tpu:
         # GPT-1.3B-class config in bf16 (h2048 l16), batch 8 x seq 1024 —
@@ -314,6 +321,17 @@ def main():
     tflops = compute_gpt_tflops(batch_size, config.seq_len, config.num_layers,
                                 config.hidden_size, config.vocab_size, n_dev,
                                 latency)
+    # MFU against the detected generation's bf16 peak — the honest
+    # number (vs_baseline divides by a V100's 37.01 for cross-framework
+    # comparability with the reference recipe, which flatters a TPU).
+    mfu = None
+    if on_tpu:
+        from alpa_tpu.mesh_profiling import (TPU_GENERATION_SPECS,
+                                             detect_tpu_generation)
+        gen = detect_tpu_generation()
+        peak = TPU_GENERATION_SPECS[gen]["peak_bf16_tflops"]
+        mfu = {"generation": gen, "peak_bf16_tflops": peak,
+               "mfu": round(tflops / peak, 4)}
     result = {
         "metric": "gpt_train_tflops_per_chip",
         "value": round(tflops, 3),
@@ -329,6 +347,7 @@ def main():
             "tokens_per_sec": round(tokens_per_sec, 1),
             "n_devices": n_dev,
             "platform": devices[0].platform,
+            **(mfu or {}),
         },
     }
     print(json.dumps(result))
