@@ -182,26 +182,33 @@ def cluster_eqns_by_cost(closed_jaxpr: ClosedJaxpr, layer_num: int,
             # v crosses every cut in (d, lu]
             cut_bytes[d + 1:lu + 1] += b
 
-    INF = float("inf")
-    # f[k][i]: min comm cost of grouping first i eqns into k layers
-    f = np.full((layer_num + 1, n + 1), INF)
+    # f[k][i]: lexicographic (comm bytes, sum of squared layer flops) of
+    # grouping the first i segments into k layers.  The flops budget
+    # applies to EVERY layer including the last (letting the final layer
+    # escape it once produced 26-of-32-layers-in-one-cluster partitions);
+    # the squared-flops term breaks comm ties toward balance — in a
+    # uniform transformer every block-boundary cut moves the same bytes,
+    # so comm alone cannot distinguish [4,4] from [7,1].
+    f = np.full((layer_num + 1, n + 1, 2), float("inf"))
     arg = np.zeros((layer_num + 1, n + 1), dtype=int)
-    f[0][0] = 0.0
+    f[0][0] = (0.0, 0.0)
     for k in range(1, layer_num + 1):
         for i in range(1, n + 1):
             for j in range(0, i):
-                if cum[i] - cum[j] > budget and k < layer_num:
+                if cum[i] - cum[j] > budget:
                     continue
-                if f[k - 1][j] == INF:
+                if f[k - 1][j][0] == float("inf"):
                     continue
-                c = f[k - 1][j] + (cut_bytes[j] if j > 0 else 0.0)
-                if c < f[k][i]:
+                seg_fl = float(cum[i] - cum[j])
+                c = (f[k - 1][j][0] + (cut_bytes[j] if j > 0 else 0.0),
+                     f[k - 1][j][1] + seg_fl * seg_fl)
+                if c < tuple(f[k][i]):
                     f[k][i] = c
                     arg[k][i] = j
     def _segs_to_eqns(seg_lo: int, seg_hi: int):
         return list(all_eqns[segments[seg_lo][0]:segments[seg_hi - 1][1]])
 
-    if f[layer_num][n] == INF:
+    if f[layer_num][n][0] == float("inf"):
         # fall back to equal-flops split over segments
         return _equal_flops_split(all_eqns, segments, flops, layer_num)
     # backtrack
