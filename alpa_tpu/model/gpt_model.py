@@ -290,12 +290,27 @@ class GPTModel(nn.Module):
             position_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
         seg_bias = None
         if segment_ids is not None:
-            cache_len = (kv_caches[0][0].shape[1]
-                         if kv_caches is not None else s)
-            seg_k = jnp.pad(segment_ids, ((0, 0), (0, cache_len - s)),
-                            constant_values=-2)
-            same = (segment_ids[:, :, None] == seg_k[:, None, :]) & \
-                (segment_ids[:, :, None] >= 0)
+            if kv_caches is not None:
+                # The packed chunk is written at the caches' current
+                # (scalar) index — 0 for a fresh packed prefill, or the
+                # prefix length when packing over a cached system prompt.
+                # Keys before that offset are the shared prefix: visible
+                # to EVERY real segment; keys past the chunk stay -2.
+                cache_len = kv_caches[0][0].shape[1]
+                start = jnp.asarray(kv_caches[0][2], jnp.int32)
+                seg_k = jnp.full((b, cache_len), -2, jnp.int32)
+                seg_k = jax.lax.dynamic_update_slice(
+                    seg_k, segment_ids, (0, start))
+                kpos = jax.lax.broadcasted_iota(
+                    jnp.int32, (1, cache_len), 1)
+                prefix_k = kpos < start                      # (1, L)
+                same = ((segment_ids[:, :, None] == seg_k[:, None, :]) |
+                        prefix_k[:, None, :]) & \
+                    (segment_ids[:, :, None] >= 0)
+            else:
+                same = (segment_ids[:, :, None] ==
+                        segment_ids[:, None, :]) & \
+                    (segment_ids[:, :, None] >= 0)
             seg_bias = jnp.where(same, 0.0, -1e9)[:, None]  # (B,1,S,L)
         tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                            dtype=cfg.dtype, name="wte")
@@ -312,10 +327,12 @@ class GPTModel(nn.Module):
             elif cfg.remat_policy is not None:
                 raise ValueError(
                     f"unknown remat_policy {cfg.remat_policy!r}")
-            # deterministic is static; attn_bias stays a traced pytree
-            # (None or the packed segment mask)
+            # Under nn.remat the module instance is arg 0, so the call
+            # (x, cache_i, deterministic, seg_bias) puts kv_cache at 2
+            # and deterministic at 3 — mark BOTH static; attn_bias (4)
+            # stays a traced pytree (None or the packed segment mask)
             block_cls = nn.remat(TransformerBlock,
-                                 static_argnums=(2,),
+                                 static_argnums=(2, 3),
                                  policy=policy)
         new_caches = [] if kv_caches is not None else None
         for i in range(cfg.num_layers):

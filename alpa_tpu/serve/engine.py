@@ -62,8 +62,10 @@ class ContinuousBatchingEngine:
         ``prefix``: a ``Generator.cache_prefix`` handle shared by EVERY
         request (system prompt): each admission prefills only its
         suffix over a copy of the prefix K/V.  Requires the generator's
-        chunked-prefill mode; mutually exclusive with packed admission
-        (pads in a pack cannot share the prefix attention region)."""
+        chunked-prefill mode (per-row admissions ride chunked suffix
+        prefill).  Composes with ``packed_admission``: the pack is then
+        prefilled at cache offset ``prefix.length`` with the prefix
+        region attendable by every segment."""
         self.gen = generator
         self.B = max_batch
         self.bucket = prompt_bucket or generator.prompt_buckets[0]
@@ -74,10 +76,6 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     "engine prefix caching requires "
                     "Generator(prefill_chunk=...)")
-            if packed_admission:
-                raise ValueError(
-                    "prefix caching and packed admission are mutually "
-                    "exclusive")
             if getattr(prefix, "params", None) is not generator.params:
                 # same guard Generator.generate enforces: a stale handle
                 # would serve plausible-but-wrong tokens silently
@@ -93,13 +91,15 @@ class ContinuousBatchingEngine:
             sig = inspect.signature(generator.model.__call__)
             if "segment_ids" in sig.parameters:
                 from alpa_tpu.serve.packed import PackedPrefill
-                # clamp to the KV-cache capacity: a packed forward
-                # longer than seq_len cannot be written into the caches
+                # clamp to the KV-cache capacity (minus any shared
+                # prefix): a packed forward longer than that cannot be
+                # written into the caches
+                plen = prefix.length if prefix is not None else 0
                 total = max(packed_bucket or 2 * self.bucket, self.bucket)
                 self._packed = PackedPrefill(
                     generator.model, generator.params, cfgm,
-                    total_bucket=min(total, cfgm.seq_len),
-                    max_rows=self.B)
+                    total_bucket=min(total, max(1, cfgm.seq_len - plen)),
+                    max_rows=self.B, prefix=prefix)
             else:
                 logger.warning(
                     "packed_admission requested but %s takes no "

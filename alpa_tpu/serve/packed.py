@@ -72,37 +72,50 @@ class PackedPrefill:
     """
 
     def __init__(self, model, params, config: GPTConfig,
-                 total_bucket: int, max_rows: int):
+                 total_bucket: int, max_rows: int, prefix=None):
+        """``prefix``: an optional ``generation.PrefixHandle`` (shared
+        system prompt).  The packed chunk is then written at cache
+        offset ``prefix.length``: every segment attends to the prefix
+        K/V plus its own span, positions continue from the prefix, and
+        the per-row re-gather lays each row out as [prefix | suffix]."""
         self.model = model
         self.params = params
         self.config = config
+        self.prefix = prefix
+        plen = int(prefix.length) if prefix is not None else 0
+        self.prefix_len = plen
         self.total_bucket = int(total_bucket)
         self.max_rows = int(max_rows)
-        assert self.total_bucket <= config.seq_len, (
-            f"packed bucket {total_bucket} exceeds KV-cache capacity "
-            f"(seq_len {config.seq_len})")
+        assert plen + self.total_bucket <= config.seq_len, (
+            f"prefix {plen} + packed bucket {total_bucket} exceeds "
+            f"KV-cache capacity (seq_len {config.seq_len})")
+        if prefix is not None and getattr(prefix, "params", None) \
+                is not params:
+            raise ValueError("PrefixHandle was built for different params")
         self.traces = 0
         row_cap = config.seq_len
+        cap = plen + self.total_bucket
 
-        def prefill(params, ids, seg, pos, starts, lens):
+        def prefill(params, ids, seg, pos, starts, lens, caches):
             self.traces += 1
-            caches = init_kv_caches(config, 1)
-            # packed caches sized to the bucket, not full seq_len
-            caches = [(k[:, :self.total_bucket], v[:, :self.total_bucket],
-                       i) for (k, v, i) in caches]
+            # packed caches sized to prefix + bucket, not full seq_len
+            caches = [(k[:, :cap], v[:, :cap], i)
+                      for (k, v, i) in caches]
             logits, caches = model.apply(params, ids, pos, caches,
                                          segment_ids=seg)
-            # one gather per layer relocates each prompt's KV span to its
-            # row-local origin; positions past len are clamped repeats,
-            # masked at decode by the per-row cache index
+            # one gather per layer relocates each prompt's KV span to
+            # its row-local origin, after the shared prefix region
+            # (copied verbatim to every row); positions past len are
+            # clamped repeats, masked at decode by the per-row index
             t = jnp.arange(row_cap)[None, :]                 # (1, cap)
-            idx = starts[:, None] + jnp.minimum(t, lens[:, None] - 1)
-            idx = jnp.minimum(idx, self.total_bucket - 1)
+            sfx = plen + starts[:, None] + jnp.minimum(
+                jnp.maximum(t - plen, 0), lens[:, None] - 1)
+            idx = jnp.minimum(jnp.where(t < plen, t, sfx), cap - 1)
             row_caches = []
             for (k, v, _i) in caches:
                 rk = k[0][idx]                               # (R, cap, H, D)
                 rv = v[0][idx]
-                row_caches.append((rk, rv, lens))
+                row_caches.append((rk, rv, plen + lens))
             last = logits[0, starts + lens - 1]              # (R, V)
             return last, row_caches
 
@@ -111,6 +124,12 @@ class PackedPrefill:
     def __call__(self, prompts: Sequence[np.ndarray]):
         ids, seg, pos, starts, lens = pack_prompts(
             prompts, self.total_bucket, self.max_rows)
+        if self.prefix is not None:
+            caches = self.prefix.caches
+            pos = pos + self.prefix_len  # global positions after prefix
+        else:
+            caches = init_kv_caches(self.config, 1)
         return self._prefill(self.params, jnp.asarray(ids),
                              jnp.asarray(seg), jnp.asarray(pos),
-                             jnp.asarray(starts), jnp.asarray(lens))
+                             jnp.asarray(starts), jnp.asarray(lens),
+                             caches)
