@@ -61,6 +61,58 @@ def _sample_logits(logits, rng, cfg: GenerationConfig):
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _warp_probs_np(logits, cfg: GenerationConfig) -> np.ndarray:
+    """Host-side probabilities under the cfg's warping (temperature +
+    top-k), matching ``_sample_logits``'s semantics (ties at the k-th
+    value survive).  float64 for exact rejection-sampling ratios."""
+    x = np.asarray(logits, np.float64)
+    if cfg.temperature != 1.0:
+        x = x / max(cfg.temperature, 1e-6)
+    if cfg.top_k > 0:
+        kth = np.partition(x, -cfg.top_k, axis=-1)[..., -cfg.top_k, None]
+        x = np.where(x < kth, -np.inf, x)
+    x = x - x.max(axis=-1, keepdims=True)
+    p = np.exp(x)
+    return p / p.sum(axis=-1, keepdims=True)
+
+
+def _sample_from_probs(p: np.ndarray, u: float) -> int:
+    """Inverse-CDF draw from a probability vector with one uniform."""
+    c = np.cumsum(p)
+    return int(np.clip(np.searchsorted(c, u * c[-1], side="right"),
+                       0, len(p) - 1))
+
+
+def speculative_accept(props, q_probs, p_probs, us, u_extra):
+    """Rejection-sampling acceptance (Leviathan et al. speculative
+    sampling): token i drawn from q_i is accepted with probability
+    min(1, p_i(x)/q_i(x)); the first rejection emits from the residual
+    norm(max(p_i - q_i, 0)); a fully-accepted round emits a bonus token
+    from p_k.  Returns (num_accepted, extra_token).  The marginal
+    distribution of every emitted token is EXACTLY p — see
+    tests/serve/test_speculative_sampling.py for the statistical proof
+    harness.
+
+    ``props``: k proposed tokens; ``q_probs``: (k, V) draft probs;
+    ``p_probs``: (k+1, V) target probs; ``us``: k uniforms;
+    ``u_extra``: one uniform for the residual/bonus draw.
+    """
+    k = len(props)
+    for i in range(k):
+        x = int(props[i])
+        ratio = p_probs[i][x] / max(q_probs[i][x], 1e-300)
+        if us[i] < min(1.0, ratio):
+            continue
+        residual = np.maximum(p_probs[i] - q_probs[i], 0.0)
+        s = residual.sum()
+        if s <= 0.0:
+            # p == q exactly: the residual is empty and acceptance was
+            # certain up to float rounding — fall back to p itself
+            residual, s = p_probs[i], p_probs[i].sum()
+        return i, _sample_from_probs(residual / s, u_extra)
+    return k, _sample_from_probs(p_probs[k], u_extra)
+
+
 def default_prompt_buckets(seq_len: int) -> List[int]:
     """Power-of-two prompt-length buckets up to seq_len."""
     buckets, b = [], 32
@@ -360,24 +412,27 @@ class Generator:
                              input_ids,
                              generation_config: Optional[
                                  GenerationConfig] = None,
-                             num_draft: int = 4):
-        """Greedy speculative decoding: ``draft`` (a small Generator over
-        the same tokenizer) proposes ``num_draft`` tokens per round; this
-        (target) model verifies them in ONE cached forward and accepts
-        the agreeing prefix plus its own next token.
+                             num_draft: int = 4,
+                             seed: int = 0):
+        """Speculative decoding: ``draft`` (a small Generator over the
+        same tokenizer) proposes ``num_draft`` tokens per round; this
+        (target) model verifies them in ONE cached forward.
 
-        Exactness: greedy speculative decoding provably emits the same
-        sequence as plain greedy decoding of the target — the draft only
-        changes how many target forwards it takes.  Cache rollback after
-        a rejection is free under the cache-as-invars design: garbage
-        K/V beyond the write index is masked, so rollback is just
-        resetting the index.  Returns (output_row, stats) where stats
-        has ``rounds`` / ``proposed`` / ``accepted``.
+        Exactness: greedy mode accepts the agreeing argmax prefix and
+        provably emits the same sequence as plain greedy decoding of the
+        target.  With ``cfg.do_sample`` the proposals are sampled from
+        the draft's (warped) distribution and accepted by rejection
+        sampling (``speculative_accept``), which makes every emitted
+        token EXACTLY target-distributed — speculation changes only how
+        many target forwards it takes.  Cache rollback after a rejection
+        is free under the cache-as-invars design: garbage K/V beyond the
+        write index is masked, so rollback is just resetting the index.
+        ``seed`` drives the sampled path's host-side randomness.
+        Returns (output_row, stats) where stats has ``rounds`` /
+        ``proposed`` / ``accepted``.
         """
         cfg = generation_config or GenerationConfig()
-        if cfg.do_sample:
-            raise ValueError("speculative decoding here is greedy; "
-                             "do_sample is not supported")
+        np_rng = np.random.default_rng(seed)
         prompt = np.asarray(input_ids, np.int32).reshape(-1)
         k = int(num_draft)
         if k < 1:
@@ -395,11 +450,18 @@ class Generator:
                 f"prompt {len(prompt)} + max_new_tokens "
                 f"{cfg.max_new_tokens}")
 
+        def pick_target(logits):
+            """Next token from target logits: argmax, or a warped draw."""
+            if not cfg.do_sample:
+                return int(np.argmax(np.asarray(logits)[0]))
+            p = _warp_probs_np(np.asarray(logits)[0], cfg)
+            return _sample_from_probs(p, np_rng.uniform())
+
         t_logits, t_caches = self._spec_prefill(self, prompt)
         d_logits, d_caches = self._spec_prefill(draft, prompt)
         del d_logits
 
-        pending = int(np.argmax(np.asarray(t_logits)[0]))
+        pending = pick_target(t_logits)
         generated = [pending]
         stats = {"rounds": 0, "proposed": 0, "accepted": 0}
         eos = cfg.eos_token_id
@@ -417,18 +479,23 @@ class Generator:
                 t_logits, t_caches = self._decode(
                     self.params, jnp.asarray([[pending]], jnp.int32),
                     t_caches[0][2], t_caches)
-                pending = int(np.argmax(np.asarray(t_logits)[0]))
+                pending = pick_target(t_logits)
                 generated.append(pending)
                 continue
             # draft proposes k_r tokens (k_r+1 decodes: the last feed
             # keeps the draft cache in lockstep with the verify write)
-            props = []
+            props, q_rows = [], []
             tok = pending
             for _ in range(k_r):
                 d_logits, d_caches = draft._decode(
                     draft.params, jnp.asarray([[tok]], jnp.int32),
                     d_caches[0][2], d_caches)
-                tok = int(np.argmax(np.asarray(d_logits)[0]))
+                if cfg.do_sample:
+                    q = _warp_probs_np(np.asarray(d_logits)[0], cfg)
+                    q_rows.append(q)
+                    tok = _sample_from_probs(q, np_rng.uniform())
+                else:
+                    tok = int(np.argmax(np.asarray(d_logits)[0]))
                 props.append(tok)
             _discard, d_caches = draft._decode(
                 draft.params, jnp.asarray([[props[-1]]], jnp.int32),
@@ -439,12 +506,19 @@ class Generator:
             toks = jnp.asarray([[pending] + props], jnp.int32)
             v_logits, t_caches = verify(self.params, toks,
                                         t_caches[0][2], t_caches)
-            t_preds = np.argmax(np.asarray(v_logits)[0], axis=-1)
-            a = 0
-            while a < k_r and t_preds[a] == props[a]:
-                a += 1
-            emitted = props[:a] + [int(t_preds[a] if a < k_r
-                                       else t_preds[k_r])]
+            if cfg.do_sample:
+                p_rows = _warp_probs_np(np.asarray(v_logits)[0], cfg)
+                a, extra = speculative_accept(
+                    props, np.stack(q_rows), p_rows,
+                    np_rng.uniform(size=k_r), np_rng.uniform())
+                emitted = props[:a] + [extra]
+            else:
+                t_preds = np.argmax(np.asarray(v_logits)[0], axis=-1)
+                a = 0
+                while a < k_r and t_preds[a] == props[a]:
+                    a += 1
+                emitted = props[:a] + [int(t_preds[a] if a < k_r
+                                           else t_preds[k_r])]
             stats["rounds"] += 1
             stats["proposed"] += k_r
             stats["accepted"] += a
