@@ -35,9 +35,11 @@ def get_mode() -> str:
     return _mode
 
 
-def functionalize(module, concrete_args=None, split_buffers=False):
+def functionalize(module, concrete_args=None, split_buffers=False,
+                  dropout=None, leaf_modules=()):
     """torch.nn.Module -> (jax_fn, params), or with ``split_buffers=True``
-    (jax_fn, trainable, buffers) — see converter.functionalize.
+    (jax_fn, trainable, buffers) — see converter.functionalize (also for
+    the ``dropout`` policy and ``leaf_modules``).
 
     The mode is consulted at CALL time, so ``set_mode`` may be called
     before or after conversion: "local" runs the function under jax.jit
@@ -45,12 +47,13 @@ def functionalize(module, concrete_args=None, split_buffers=False):
     """
     import functools
     import jax
-    out = _functionalize(module, concrete_args, split_buffers)
+    out = _functionalize(module, concrete_args, split_buffers,
+                         dropout=dropout, leaf_modules=leaf_modules)
     fn = out[0]
     jitted = jax.jit(fn)
 
     @functools.wraps(fn)
-    def dispatch(p, *inputs):
-        return (jitted if _mode == "local" else fn)(p, *inputs)
+    def dispatch(p, *inputs, **kw):
+        return (jitted if _mode == "local" else fn)(p, *inputs, **kw)
 
     return (dispatch,) + tuple(out[1:])

@@ -14,7 +14,7 @@ dropout (eval = identity).
 import logging
 import operator
 import warnings
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, List
 
 import jax
 import jax.numpy as jnp
@@ -257,6 +257,19 @@ def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return y
 
 
+def _torch_dtype_to_jnp(dtype):
+    """torch.dtype -> jnp dtype (None passes through; an unmapped torch
+    dtype raises rather than silently producing the wrong dtype)."""
+    if dtype is None:
+        return None
+    name = str(dtype).replace("torch.", "")
+    try:
+        return jnp.dtype(name)
+    except TypeError as e:
+        raise NotImplementedError(
+            f"torch dtype {dtype} has no jnp mapping") from e
+
+
 def _scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                   is_causal=False, scale=None, **_):
     d = q.shape[-1]
@@ -288,7 +301,9 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "tanh": jnp.tanh,
     "softmax": _softmax,
     "log_softmax": lambda x, dim=-1, **_: jax.nn.log_softmax(x, axis=dim),
-    "dropout": lambda x, p=0.5, training=False, inplace=False: x,
+    # NOTE: "dropout" is intentionally absent — every dropout node is
+    # intercepted by fx_to_jax's dropout_site handling (one source of
+    # truth for the explicit dropout policy)
     "layer_norm": _layer_norm,
     "group_norm": _group_norm,
     "batch_norm": _batch_norm,
@@ -329,7 +344,8 @@ FUNCTION_MAP: Dict[str, Callable] = {
         x, axis=dim, keepdims=keepdim),
     "argmin": lambda x, dim=None, keepdim=False: jnp.argmin(
         x, axis=dim, keepdims=keepdim),
-    "arange": jnp.arange,
+    "arange": lambda *a, dtype=None, device=None, **_: jnp.arange(
+        *a, dtype=_torch_dtype_to_jnp(dtype)),
     "ones": lambda *s, dtype=None, device=None, **_: jnp.ones(
         s[0] if len(s) == 1 and isinstance(s[0], (tuple, list)) else s),
     "zeros": lambda *s, dtype=None, device=None, **_: jnp.zeros(
@@ -365,7 +381,10 @@ FUNCTION_MAP: Dict[str, Callable] = {
     "squeeze": lambda x, dim=None: jnp.squeeze(x, dim),
     "masked_fill": lambda x, mask, val: jnp.where(mask, val, x),
     "getitem": operator.getitem,
-    "getattr": getattr,
+    # tensor attribute reads; .device has no jax analog (torch code uses
+    # it only to place new tensors, which jax tracing makes moot)
+    "getattr": lambda x, name: None if name == "device" else getattr(
+        x, name),
     "float": lambda x: x.astype(jnp.float32),
     "size": lambda x, d=None: x.shape if d is None else x.shape[d],
     "to": lambda x, *a, **k: x,
@@ -466,6 +485,8 @@ def _convert_module(mod, params_prefix: str):
         return lambda p, x: _adaptive_avg_pool2d(x, out)
     if isinstance(mod, torch.nn.Identity):
         return lambda p, x: x
+    if type(mod).__name__ == "GPT2Block":
+        return _convert_gpt2_block(mod, params_prefix)
     if isinstance(mod, torch.nn.MultiheadAttention):
         if not mod._qkv_same_embed_dim:
             raise NotImplementedError(
@@ -518,19 +539,100 @@ def _convert_module(mod, params_prefix: str):
         f"torch module {type(mod).__name__} has no jax mapping yet")
 
 
+def _convert_gpt2_block(mod, params_prefix: str):
+    """transformers ``GPT2Block`` as a LEAF module (HF GPT-2 family
+    support; the block's own fx graph is untraceable across transformers
+    versions — its mask/shape helpers iterate proxies).  Weights are the
+    block's own state_dict entries (Conv1D convention: weight is
+    (in, out), applied as x @ w + b).  Causality must arrive via the
+    additive ``attention_mask`` the caller passes — matching the modern
+    eager path where ``create_causal_mask`` supplies it.
+    Verified logit-exact against transformers in
+    tests/torch_frontend/test_gpt2.py."""
+    attn = mod.attn
+    if getattr(attn, "is_cross_attention", False):
+        raise NotImplementedError("GPT2Block cross-attention")
+    if getattr(attn, "scale_attn_by_inverse_layer_idx", False) or \
+            getattr(attn, "reorder_and_upcast_attn", False):
+        raise NotImplementedError(
+            "GPT2Block with scale_attn_by_inverse_layer_idx / "
+            "reorder_and_upcast_attn")
+    nh = attn.num_heads
+    hd = attn.head_dim
+    scale = (1.0 / np.sqrt(hd)) if getattr(attn, "scale_attn_weights",
+                                           True) else 1.0
+    eps1, eps2 = mod.ln_1.eps, mod.ln_2.eps
+    act_name = type(mod.mlp.act).__name__
+    if act_name not in ("NewGELUActivation", "GELUActivation"):
+        raise NotImplementedError(f"GPT2 MLP activation {act_name}")
+    approximate = act_name == "NewGELUActivation"
+    pf = params_prefix
+
+    def f(p, x, attention_mask=None, **_ignored):
+        if attention_mask is None:
+            # On transformers versions where causality lives inside
+            # GPT2Attention (bias buffer) the traced caller may pass no
+            # mask; running unmasked would be silently NON-causal.
+            raise ValueError(
+                "GPT2Block leaf conversion requires an explicit "
+                "additive attention_mask carrying causality (e.g. "
+                "0 / finfo.min lower-triangular, shape (1,1,S,S))")
+        e = x.shape[-1]
+        a = _layer_norm(x, (e,), p[pf + "ln_1.weight"],
+                        p[pf + "ln_1.bias"], eps1)
+        qkv = a @ p[pf + "attn.c_attn.weight"] + p[pf + "attn.c_attn.bias"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+        scores = scores + attention_mask
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        b, _, s, _ = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+        out = out @ p[pf + "attn.c_proj.weight"] + \
+            p[pf + "attn.c_proj.bias"]
+        x = x + out
+        m = _layer_norm(x, (e,), p[pf + "ln_2.weight"],
+                        p[pf + "ln_2.bias"], eps2)
+        h = m @ p[pf + "mlp.c_fc.weight"] + p[pf + "mlp.c_fc.bias"]
+        h = jax.nn.gelu(h, approximate=approximate)
+        h = h @ p[pf + "mlp.c_proj.weight"] + p[pf + "mlp.c_proj.bias"]
+        return (x + h,)
+
+    return f
+
+
 ########################################
 # graph conversion
 ########################################
 
 
-def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
-    """Convert an fx.GraphModule into fn(params, *inputs).
+def fx_to_jax(gm, params: Dict[str, Any],
+              dropout_mode: str = "identity") -> Callable:
+    """Convert an fx.GraphModule into fn(params, *inputs, rng=None).
 
     ``params`` is used to validate at conversion time that every
     ``get_attr`` target has a backing entry, so missing-parameter errors
-    surface here rather than on first call."""
+    surface here rather than on first call.
+
+    ``dropout_mode`` decides what ACTIVE dropout sites (train-mode
+    nn.Dropout / F.dropout(training=True) with p > 0) do — an explicit
+    policy instead of silently dropping the op:
+      * "identity": dropout disabled; the trace is deterministic.
+      * "rng": real inverted dropout; the converted function takes a
+        ``rng`` keyword (a jax PRNG key) and derives one independent key
+        per site via fold_in.  Calling without ``rng`` raises.
+    Inactive sites (eval mode or p == 0) are identity either way."""
     import torch
 
+    if dropout_mode not in ("identity", "rng"):
+        raise ValueError(f"dropout_mode {dropout_mode!r}: expected "
+                         "'identity' or 'rng'")
     modules = dict(gm.named_modules())
     missing = [n.target for n in gm.graph.nodes
                if n.op == "get_attr" and n.target not in params]
@@ -539,13 +641,38 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
                        f"{missing}")
     # Convert every module once at conversion time: unmapped modules fail
     # here (the documented contract), and calls avoid per-invocation
-    # isinstance dispatch.
+    # isinstance dispatch.  nn.Dropout is handled inline (its behavior
+    # depends on dropout_mode + the per-site rng), so it is excluded.
     module_fns = {
         n.target: _convert_module(modules[n.target], n.target + ".")
         for n in gm.graph.nodes if n.op == "call_module"
+        and not isinstance(modules[n.target], torch.nn.Dropout)
+    }
+    # stable per-site indices for rng fold_in
+    dropout_site = {
+        n.name: i
+        for i, n in enumerate(
+            n for n in gm.graph.nodes
+            if (n.op == "call_module" and
+                isinstance(modules.get(n.target), torch.nn.Dropout)) or
+            (n.op in ("call_function", "call_method") and
+             getattr(n.target, "__name__", str(n.target)) == "dropout"))
     }
 
-    def fn(p, *inputs):
+    def _apply_dropout(x, p_drop, training, node_name, rng):
+        if not training or p_drop <= 0.0:
+            return x
+        if dropout_mode == "identity":
+            return x
+        if rng is None:
+            raise ValueError(
+                "this converted function has active dropout under "
+                "dropout_mode='rng'; pass fn(params, *inputs, rng=key)")
+        key = jax.random.fold_in(rng, dropout_site[node_name])
+        keep = jax.random.bernoulli(key, 1.0 - p_drop, x.shape)
+        return jnp.where(keep, x / (1.0 - p_drop), jnp.zeros_like(x))
+
+    def fn(p, *inputs, rng=None):
         env: Dict[str, Any] = {}
         input_iter = iter(inputs)
 
@@ -566,27 +693,35 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
             elif node.op == "get_attr":
                 key = node.target
                 env[node.name] = p[key]
-            elif node.op == "call_function":
-                fname = getattr(node.target, "__name__", str(node.target))
+            elif node.op in ("call_function", "call_method"):
+                fname = (getattr(node.target, "__name__", str(node.target))
+                         if node.op == "call_function" else node.target)
+                args = [lookup(a) for a in node.args]
+                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                if node.name in dropout_site:
+                    # torch.nn.functional.dropout defaults training=TRUE
+                    env[node.name] = _apply_dropout(
+                        args[0],
+                        kwargs.get("p", args[1] if len(args) > 1 else 0.5),
+                        kwargs.get("training",
+                                   args[2] if len(args) > 2 else True),
+                        node.name, rng)
+                    continue
                 f = FUNCTION_MAP.get(fname)
                 if f is None:
                     raise NotImplementedError(
-                        f"torch function {fname} has no jax mapping yet")
-                args = [lookup(a) for a in node.args]
-                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
-                env[node.name] = f(*args, **kwargs)
-            elif node.op == "call_method":
-                f = FUNCTION_MAP.get(node.target)
-                if f is None:
-                    raise NotImplementedError(
-                        f"tensor method {node.target} has no jax mapping")
-                args = [lookup(a) for a in node.args]
-                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                        f"torch {node.op} {fname} has no jax mapping yet")
                 env[node.name] = f(*args, **kwargs)
             elif node.op == "call_module":
-                mf = module_fns[node.target]
                 args = [lookup(a) for a in node.args]
-                env[node.name] = mf(p, *args)
+                if node.name in dropout_site:
+                    mod = modules[node.target]
+                    env[node.name] = _apply_dropout(
+                        args[0], mod.p, mod.training, node.name, rng)
+                    continue
+                mf = module_fns[node.target]
+                kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
+                env[node.name] = mf(p, *args, **kwargs)
             elif node.op == "output":
                 out = lookup(node.args[0])
         return out
@@ -594,14 +729,55 @@ def fx_to_jax(gm, params: Dict[str, Any]) -> Callable:
     return fn
 
 
-def functionalize(module, concrete_args=None, split_buffers=False):
+def _find_active_dropout(gm) -> List[str]:
+    """Dropout sites in a traced graph that would actually fire: train-
+    mode nn.Dropout modules with p > 0, and functional F.dropout calls
+    whose (traced-literal) training flag isn't False — torch's default
+    is training=TRUE, and a proxied/unknown flag counts as active
+    (conservative: the explicit-policy refusal must not be evadable)."""
+    import torch
+    import torch.fx
+
+    mods = dict(gm.named_modules())
+    active = []
+    for n in gm.graph.nodes:
+        if n.op == "call_module" and \
+                isinstance(mods.get(n.target), torch.nn.Dropout):
+            m = mods[n.target]
+            if m.training and m.p > 0:
+                active.append(n.target)
+        elif n.op in ("call_function", "call_method") and \
+                getattr(n.target, "__name__", str(n.target)) == "dropout":
+            p = n.kwargs.get("p", n.args[1] if len(n.args) > 1 else 0.5)
+            tr = n.kwargs.get("training",
+                              n.args[2] if len(n.args) > 2 else True)
+            p_active = not isinstance(p, (int, float)) or p > 0
+            tr_active = not (tr is False)
+            if p_active and tr_active:
+                active.append(n.name)
+    return active
+
+
+def functionalize(module, concrete_args=None, split_buffers=False,
+                  dropout=None, leaf_modules=()):
     """torch.nn.Module -> (jax_fn, params_dict).
 
     jax_fn(params, *jax_inputs) reproduces module.forward in the module's
     CURRENT train/eval mode (ref: the functionalized nn of alpa/torch/nn/).
     Train-mode tracing warns: BatchNorm uses batch statistics (matching
-    torch), but the running-stat update and dropout randomness are side
-    effects the functional trace drops.
+    torch), but the running-stat update is a side effect the functional
+    trace drops.
+
+    ``dropout`` is the EXPLICIT policy for train-mode dropout (a
+    train-mode module containing active dropout refuses to convert
+    without one — silently dropping randomness mistrains):
+      * "identity": dropout off, deterministic trace.
+      * "rng": real dropout; call ``jax_fn(params, *inputs, rng=key)``.
+
+    ``leaf_modules``: extra module CLASSES the fx tracer must not
+    descend into — they convert via ``_convert_module``'s explicit
+    mappings instead (e.g. transformers' GPT2Block, whose internals
+    resist symbolic tracing).
 
     With ``split_buffers=True`` returns (jax_fn, trainable, buffers):
     ``trainable`` holds entries backed by torch Parameters, ``buffers``
@@ -616,15 +792,38 @@ def functionalize(module, concrete_args=None, split_buffers=False):
     if module.training:
         warnings.warn(
             "functionalize: tracing a train-mode module — BatchNorm uses "
-            "batch statistics but running-stat updates and dropout are "
-            "dropped by the functional trace; call .eval() first for "
-            "eval semantics", stacklevel=2)
-    gm = torch.fx.symbolic_trace(module, concrete_args=concrete_args)
+            "batch statistics but running-stat updates are dropped by "
+            "the functional trace; call .eval() first for eval "
+            "semantics", stacklevel=2)
+
+    if leaf_modules:
+        leaf_classes = tuple(leaf_modules)
+
+        class _LeafTracer(torch.fx.Tracer):
+
+            def is_leaf_module(self, m, qualname):
+                return (isinstance(m, leaf_classes) or
+                        super().is_leaf_module(m, qualname))
+
+        graph = _LeafTracer().trace(module, concrete_args=concrete_args)
+        gm = torch.fx.GraphModule(module, graph)
+    else:
+        gm = torch.fx.symbolic_trace(module, concrete_args=concrete_args)
+
+    if dropout is None:
+        active = _find_active_dropout(gm)
+        if active:
+            raise ValueError(
+                "functionalize: module has active dropout "
+                f"({active}); choose an explicit policy: "
+                "dropout='identity' (deterministic, dropout off) or "
+                "dropout='rng' (real dropout, pass rng=key per call) — "
+                "or .eval() the module")
     params = {
         k: torch_to_jax_array(v)
         for k, v in {**dict(module.state_dict())}.items()
     }
-    fn = fx_to_jax(gm, params)
+    fn = fx_to_jax(gm, params, dropout_mode=dropout or "identity")
     if split_buffers:
         pnames = {k for k, _ in module.named_parameters()}
         trainable = {k: v for k, v in params.items() if k in pnames}

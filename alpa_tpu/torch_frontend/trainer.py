@@ -19,23 +19,31 @@ class TorchTrainer:
 
     ``loss_func(out, target) -> scalar`` operates on jax arrays.
     ``method``: any alpa_tpu ParallelMethod (None = ShardParallel).
+    ``dropout``: the explicit train-mode dropout policy forwarded to
+    ``functionalize`` ("identity" or "rng"; required when the module
+    has active dropout).
     """
 
     def __init__(self, module, loss_func: Callable, optim_gen,
-                 method: Optional[Any] = None, concrete_args=None):
+                 method: Optional[Any] = None, concrete_args=None,
+                 dropout: Optional[str] = None):
         import alpa_tpu
         from alpa_tpu.torch_frontend import functionalize
 
-        self.fn, params = functionalize(module, concrete_args)
+        self.fn, params = functionalize(module, concrete_args,
+                                        dropout=dropout)
         optim_func, _init, optim_state = optim_gen(params)
         self.state = TrainState(params, optim_state)
         fn = self.fn
+        self._use_rng = dropout == "rng"
 
-        def train_step(state, batch):
+        use_rng = self._use_rng
+
+        def step_body(state, batch, rng):
             inputs, target = batch
 
             def compute_loss(p):
-                out = fn(p, inputs)
+                out = fn(p, inputs, rng=rng) if use_rng else fn(p, inputs)
                 return loss_func(out, target)
 
             loss, grads = alpa_tpu.value_and_grad(compute_loss)(
@@ -43,6 +51,18 @@ class TorchTrainer:
             params2, optim2 = optim_func(state.params, state.optim_state,
                                          grads)
             return TrainState(params2, optim2), loss
+
+        if use_rng:
+            # real dropout: one fresh key per step, split host-side and
+            # passed as a regular (non-batch) argument
+            import jax
+            self._key = jax.random.PRNGKey(0)
+
+            def train_step(state, batch, rng):
+                return step_body(state, batch, rng)
+        else:
+            def train_step(state, batch):
+                return step_body(state, batch, None)
 
         method = method or alpa_tpu.ShardParallel()
         self.train_step = alpa_tpu.parallelize(train_step, method=method,
@@ -58,7 +78,14 @@ class TorchTrainer:
             inputs = torch_to_jax_array(inputs)
         if hasattr(target, "detach"):
             target = torch_to_jax_array(target)
-        self.state, loss = self.train_step(self.state, (inputs, target))
+        if self._use_rng:
+            import jax
+            self._key, sub = jax.random.split(self._key)
+            self.state, loss = self.train_step(self.state,
+                                               (inputs, target), sub)
+        else:
+            self.state, loss = self.train_step(self.state,
+                                               (inputs, target))
         return float(loss)
 
     def fit(self, dataloader, num_epochs: int = 1):

@@ -1,0 +1,236 @@
+"""HF GPT-2 (torch) through the fx frontend, end to end (VERDICT r4
+next #9): transformers' GPT2Block converts as a leaf module (the
+explicit mapping in converter._convert_gpt2_block), the wrapper drives
+the genuine HF submodules, logits match transformers exactly, and a
+parallelized train step on the 8-device CPU mesh matches torch
+autograd + SGD numerics.
+
+Also covers the explicit dropout policy: train-mode dropout refuses to
+convert without a choice; 'identity' is deterministic; 'rng' applies
+real per-site dropout.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+
+import alpa_tpu                                                # noqa: E402
+from alpa_tpu.torch_frontend import functionalize              # noqa: E402
+
+
+def _tiny_gpt2():
+    from transformers import GPT2Config, GPT2LMHeadModel
+    cfg = transformers.GPT2Config(
+        n_layer=2, n_embd=64, n_head=4, vocab_size=128, n_positions=64,
+        attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+        attn_implementation="eager")
+    torch.manual_seed(0)
+    return GPT2LMHeadModel(cfg).eval()
+
+
+class GPT2Wrapper(torch.nn.Module):
+    """Drives the genuine HF GPT-2 submodules with an explicit additive
+    causal mask (transformers' own create_causal_mask path resists fx
+    tracing; the blocks themselves convert as leaves)."""
+
+    def __init__(self, m):
+        super().__init__()
+        t = m.transformer
+        self.wte, self.wpe, self.h, self.ln_f = t.wte, t.wpe, t.h, t.ln_f
+        self.lm_head = m.lm_head
+
+    def forward(self, input_ids, causal_mask):
+        pos = torch.arange(input_ids.size(1), device=input_ids.device)
+        x = self.wte(input_ids) + self.wpe(pos)
+        for block in self.h:
+            x = block(x, attention_mask=causal_mask)[0]
+        return self.lm_head(self.ln_f(x))
+
+
+def _causal_mask(s):
+    return np.where(np.tril(np.ones((s, s), bool)), 0.0,
+                    np.float32(np.finfo(np.float32).min))[None, None] \
+        .astype(np.float32)
+
+
+def _functionalized(model):
+    from transformers.models.gpt2.modeling_gpt2 import GPT2Block
+    return functionalize(GPT2Wrapper(model).eval(),
+                         leaf_modules=(GPT2Block,))
+
+
+class TestGPT2Forward:
+
+    def test_logits_match_transformers(self):
+        model = _tiny_gpt2()
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 128, (2, 12))
+        want = model(torch.tensor(ids)).logits.detach().numpy()
+
+        fn, params = _functionalized(model)
+        got = np.asarray(fn(params, jnp.asarray(ids),
+                            jnp.asarray(_causal_mask(12))))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestGPT2Train:
+
+    def test_parallelized_sgd_step_matches_torch(self):
+        """One CE-loss SGD step, parallelized on the 8-device CPU mesh,
+        lands on the same parameters torch autograd computes."""
+        model = _tiny_gpt2()
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 128, (8, 12))
+        labels = rng.randint(0, 128, (8, 12))
+        lr = 0.05
+
+        # ---- torch side ----
+        tm = _tiny_gpt2()
+        tm.train()  # grads; dropout probs are 0 in this config
+        logits = GPT2Wrapper(tm)(torch.tensor(ids),
+                                 torch.tensor(_causal_mask(12)))
+        loss_t = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, 128), torch.tensor(labels).reshape(-1))
+        loss_t.backward()
+        with torch.no_grad():
+            torch_after = {
+                k: (v - lr * v.grad).detach().numpy()
+                for k, v in tm.named_parameters() if v.grad is not None
+            }
+
+        # ---- alpa_tpu side ----
+        fn, params = _functionalized(model)
+        mask = jnp.asarray(_causal_mask(12))
+
+        def train_step(params, batch):
+
+            def loss_fn(p):
+                lg = fn(p, batch["ids"], mask)
+                ll = jax.nn.log_softmax(lg.reshape(-1, 128))
+                return -jnp.mean(
+                    jnp.take_along_axis(
+                        ll, batch["labels"].reshape(-1, 1), axis=1))
+
+            loss, grads = alpa_tpu.value_and_grad(loss_fn)(params)
+            new = jax.tree_util.tree_map(lambda w, g: w - lr * g,
+                                         params, grads)
+            return new, loss
+
+        alpa_tpu.init(cluster="local")
+        pstep = alpa_tpu.parallelize(
+            train_step, method=alpa_tpu.DataParallel(), batch_argnums=(1,))
+        batch = {"ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+        new_params, loss_j = pstep(params, batch)
+
+        np.testing.assert_allclose(float(loss_j), float(loss_t),
+                                   rtol=1e-4, atol=1e-4)
+        # wte/lm_head are tied in HF GPT-2: torch accumulates ONE grad
+        # for the shared tensor while the jax params dict carries two
+        # separately-updated entries (their grads sum to torch's — see
+        # test_tied_embedding_gradients), so compare every non-tied
+        # parameter.  torch names carry a "transformer." prefix the
+        # wrapper's state_dict does not.
+        checked = 0
+        for k, want in torch_after.items():
+            k2 = k[len("transformer."):] if \
+                k.startswith("transformer.") else k
+            if k2 not in new_params or k2.startswith("wte") or \
+                    k2 == "lm_head.weight":
+                continue
+            np.testing.assert_allclose(np.asarray(new_params[k2]), want,
+                                       rtol=2e-3, atol=2e-3, err_msg=k2)
+            checked += 1
+        assert checked >= 10  # ln/attn/mlp params across both blocks
+
+    def test_tied_embedding_gradients(self):
+        """HF GPT-2 ties wte and lm_head; the functionalized params hold
+        two entries backed by the same torch tensor.  The jax grads of
+        the two must SUM to torch's tied grad."""
+        model = _tiny_gpt2()
+        tm = _tiny_gpt2()
+        tm.train()
+        rng = np.random.RandomState(2)
+        ids = rng.randint(0, 128, (4, 8))
+        labels = rng.randint(0, 128, (4, 8))
+        logits = GPT2Wrapper(tm)(torch.tensor(ids),
+                                 torch.tensor(_causal_mask(8)))
+        loss_t = torch.nn.functional.cross_entropy(
+            logits.reshape(-1, 128), torch.tensor(labels).reshape(-1))
+        loss_t.backward()
+        tied_grad = tm.transformer.wte.weight.grad.numpy()
+
+        fn, params = _functionalized(model)
+        mask = jnp.asarray(_causal_mask(8))
+
+        def loss_fn(p):
+            lg = fn(p, jnp.asarray(ids), mask)
+            ll = jax.nn.log_softmax(lg.reshape(-1, 128))
+            return -jnp.mean(jnp.take_along_axis(
+                ll, jnp.asarray(labels).reshape(-1, 1), axis=1))
+
+        grads = jax.grad(loss_fn)(params)
+        got = np.asarray(grads["wte.weight"]) + \
+            np.asarray(grads["lm_head.weight"])
+        np.testing.assert_allclose(got, tied_grad, rtol=2e-3, atol=2e-3)
+
+
+class TestDropoutPolicy:
+
+    def _mlp(self, p=0.5):
+        torch.manual_seed(0)
+        return torch.nn.Sequential(
+            torch.nn.Linear(8, 16), torch.nn.ReLU(),
+            torch.nn.Dropout(p), torch.nn.Linear(16, 4))
+
+    def test_train_mode_dropout_requires_choice(self):
+        m = self._mlp().train()
+        with pytest.raises(ValueError, match="explicit policy"):
+            functionalize(m)
+
+    def test_identity_policy_is_deterministic(self):
+        m = self._mlp().train()
+        with pytest.warns(UserWarning):
+            fn, params = functionalize(m, dropout="identity")
+        x = jnp.ones((2, 8))
+        a, b = fn(params, x), fn(params, x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # identity == the eval-mode module's output
+        want = self._mlp().eval()(torch.ones(2, 8)).detach().numpy()
+        np.testing.assert_allclose(np.asarray(a), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_rng_policy_applies_real_dropout(self):
+        m = self._mlp(p=0.4).train()
+        with pytest.warns(UserWarning):
+            fn, params = functionalize(m, dropout="rng")
+        x = jnp.ones((4, 8))
+        with pytest.raises(ValueError, match="rng"):
+            fn(params, x)
+        a = np.asarray(fn(params, x, rng=jax.random.PRNGKey(0)))
+        b = np.asarray(fn(params, x, rng=jax.random.PRNGKey(1)))
+        c = np.asarray(fn(params, x, rng=jax.random.PRNGKey(0)))
+        assert not np.array_equal(a, b)          # random across keys
+        np.testing.assert_array_equal(a, c)      # deterministic per key
+        # unbiased in expectation: mean over many keys ~ eval output
+        outs = [np.asarray(fn(params, x, rng=jax.random.PRNGKey(s)))
+                for s in range(300)]
+        fn2, p2 = functionalize(self._mlp(p=0.4).eval())
+        det = np.asarray(fn2(p2, x))
+        np.testing.assert_allclose(np.mean(outs, axis=0), det,
+                                   rtol=0.25, atol=0.25)
+
+    def test_eval_mode_needs_no_choice(self):
+        m = self._mlp().eval()
+        fn, params = functionalize(m)
+        want = m(torch.ones(2, 8)).detach().numpy()
+        np.testing.assert_allclose(
+            np.asarray(fn(params, jnp.ones((2, 8)))), want,
+            rtol=1e-5, atol=1e-5)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
