@@ -54,4 +54,8 @@ leg h2048l24-lean    env ALPA_TPU_BENCH_OPT=bf16adam \
                      timeout 700 python bench.py --self-timeout 640
 leg flash-compare    timeout 600 python scripts/flash_longseq_bench.py compare
 leg flash-blocks     timeout 600 python scripts/flash_longseq_bench.py blocks
+#   6. HBM-estimator validation: estimate_hbm_gb vs measured
+#      peak_bytes_in_use per gated rung (VERDICT r4 next #8) — its own
+#      probe-between-rungs discipline inside
+leg hbm-check        timeout 1800 python scripts/hbm_estimator_check.py
 echo "=== runbook complete" | tee -a "$OUT"

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe the chip every 10 min; the moment it answers, run the recovery
+# runbook (which banks the known-good bench FIRST).  Log everything.
+cd "$(dirname "$0")/.."
+LOG=benchmark/results/chip_watch.log
+mkdir -p benchmark/results
+while true; do
+    echo "[$(date -u +%H:%M:%S)] probe" >> "$LOG"
+    if timeout 120 python bench.py --probe >> "$LOG" 2>&1; then
+        echo "[$(date -u +%H:%M:%S)] CHIP ALIVE - running runbook" >> "$LOG"
+        bash scripts/chip_recovery_runbook.sh >> "$LOG" 2>&1
+        echo "[$(date -u +%H:%M:%S)] runbook done rc=$?" >> "$LOG"
+        exit 0
+    fi
+    echo "[$(date -u +%H:%M:%S)] wedged; sleeping 600s" >> "$LOG"
+    sleep 600
+done

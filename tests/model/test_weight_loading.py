@@ -1,4 +1,7 @@
 """HF GPT-2 weight conversion parity (ref llm_serving weight loading)."""
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -158,3 +161,29 @@ class TestDiskShardedLoading:
         got = model.apply(loaded, jnp.asarray(ids))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-6, atol=1e-6)
+
+
+class TestLoadingDrill:
+    """The 10B-class loading drill's wiring at toy scale (VERDICT r4
+    next #10): synthesize-to-disk -> tp-sharded memmap load -> jit
+    forward, AND the same checkpoint through a pipeshard inference
+    executable, both verified against an independent streamed
+    layer-at-a-time reference.  scripts/loading_drill_10b.py runs the
+    identical code at ~10B params; its artifact is
+    benchmark/results/loading_drill_10b.json."""
+
+    def test_drill_small_mode(self):
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "loading_drill_10b.py"),
+             "--small", "--dir", "/tmp/loading_drill_test"],
+            capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        last = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert last["tp8_rel_diff"] < 1e-3
+        assert last["pipeshard_rel_diff"] < 1e-3
