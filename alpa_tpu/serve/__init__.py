@@ -12,3 +12,5 @@ from alpa_tpu.serve.controller import (Controller, RequestBatcher,
 from alpa_tpu.serve.engine import ContinuousBatchingEngine
 from alpa_tpu.serve.hf_wrapper import WrappedInferenceModel, get_hf_model
 from alpa_tpu.serve.packed import PackedPrefill, pack_prompts
+from alpa_tpu.serve.scheduler import (FIFOQueue, NestedScheduler,
+                                      WeightedFairQueue)

@@ -123,10 +123,12 @@ class RequestBatcher:
 
 class _Replica:
 
-    def __init__(self, generator: Generator, prefix=None):
+    def __init__(self, generator: Generator, prefix=None,
+                 scheduler_factory=None):
         self.generator = generator
         self.batcher = RequestBatcher(generator, prefix=prefix)
         self.prefix = prefix
+        self.scheduler_factory = scheduler_factory
         self._engine = None
         self._lock = threading.Lock()
 
@@ -139,10 +141,12 @@ class _Replica:
         with self._lock:
             if self._engine is None:
                 from alpa_tpu.serve.engine import ContinuousBatchingEngine
+                sched = (self.scheduler_factory()
+                         if self.scheduler_factory else None)
                 self._engine = ContinuousBatchingEngine(
                     self.generator,
                     prompt_bucket=self.generator.prompt_buckets[-1],
-                    prefix=self.prefix)
+                    prefix=self.prefix, scheduler=sched)
             return self._engine
 
 
@@ -156,13 +160,18 @@ class Controller:
         self._lock = threading.Lock()
 
     def register_model(self, name: str, generator: Generator,
-                       prefix_ids=None):
+                       prefix_ids=None, scheduler_factory=None):
         """``prefix_ids``: optional shared system prompt — its KV is
         precomputed once (Generator.cache_prefix; requires the
         generator's chunked-prefill mode) and every request to this
         model (batched or streamed) sends only its suffix.  All
         replicas of one model must register the SAME prefix: round-robin
-        dispatch must not change what prompt_ids mean."""
+        dispatch must not change what prompt_ids mean.
+
+        ``scheduler_factory``: builds this replica's engine admission
+        policy (``serve.scheduler``, e.g.
+        ``lambda: WeightedFairQueue({"paid": 4})``); streamed requests
+        carry a ``"queue"`` field to pick their named queue."""
         prefix_ids = (None if prefix_ids is None
                       else np.asarray(prefix_ids, np.int32).reshape(-1))
 
@@ -195,7 +204,8 @@ class Controller:
             else:
                 self._prefix_ids[name] = prefix_ids
             self._models.setdefault(name, []).append(
-                _Replica(generator, prefix=prefix))
+                _Replica(generator, prefix=prefix,
+                         scheduler_factory=scheduler_factory))
             self._rr.setdefault(name, 0)
         logger.info("registered model %s (%d replicas%s)", name,
                     len(self._models[name]),
@@ -244,7 +254,14 @@ class Controller:
             raise ValueError(
                 "streaming accepts exactly one prompt per request; got "
                 f"{prompt_ids.shape[0]} rows")
-        return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg)
+        queue = request.get("queue")
+        if queue is not None and (not isinstance(queue, str) or
+                                  len(queue) > 64):
+            # untrusted input headed for scheduler dict keys: reject
+            # non-strings (unhashable lists would 500) and cap length
+            raise ValueError("queue must be a string of <= 64 chars")
+        return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg,
+                                            queue=queue)
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -52,7 +52,8 @@ class ContinuousBatchingEngine:
                  prompt_bucket: Optional[int] = None,
                  packed_admission: bool = False,
                  packed_bucket: Optional[int] = None,
-                 prefix: Optional[Any] = None):
+                 prefix: Optional[Any] = None,
+                 scheduler: Optional[Any] = None):
         """``packed_admission=True`` admits multiple queued prompts with
         ONE packed prefill (segment-masked, serve.packed.PackedPrefill —
         the 1-D batching analog) instead of one prefill per row; falls
@@ -65,7 +66,12 @@ class ContinuousBatchingEngine:
         chunked-prefill mode (per-row admissions ride chunked suffix
         prefill).  Composes with ``packed_admission``: the pack is then
         prefilled at cache offset ``prefix.length`` with the prefix
-        region attendable by every segment."""
+        region attendable by every segment.
+
+        ``scheduler``: an admission policy speaking the queue protocol
+        (``serve.scheduler``: FIFOQueue default, WeightedFairQueue,
+        NestedScheduler).  ``submit(..., queue=name)`` routes requests
+        to named queues; admission order follows the policy."""
         self.gen = generator
         self.B = max_batch
         self.bucket = prompt_bucket or generator.prompt_buckets[0]
@@ -115,7 +121,10 @@ class ContinuousBatchingEngine:
         self._logits = jnp.zeros((self.B, cfgm.vocab_size), jnp.float32)
         self._active = np.zeros((self.B,), bool)
         self._rows: List[Optional[dict]] = [None] * self.B
-        self._queue: List[dict] = []
+        if scheduler is None:
+            from alpa_tpu.serve.scheduler import FIFOQueue
+            scheduler = FIFOQueue()
+        self._queue = scheduler
         self._cv = threading.Condition()
         self._rng = jax.random.PRNGKey(0)
         self.admissions = 0
@@ -149,11 +158,12 @@ class ContinuousBatchingEngine:
 
     def submit(self, prompt: np.ndarray,
                cfg: Optional[GenerationConfig] = None,
-               on_token=None) -> np.ndarray:
+               on_token=None, queue: Optional[str] = None) -> np.ndarray:
         """Blocking generate for one prompt; rides the shared batch.
         ``on_token(int)`` is invoked from the engine loop as each token
-        lands (streaming hook; must not block)."""
-        item = self._make_item(prompt, cfg, on_token)
+        lands (streaming hook; must not block).  ``queue`` names the
+        scheduler queue this request rides (policy-dependent)."""
+        item = self._make_item(prompt, cfg, on_token, queue=queue)
         with self._cv:
             self._queue.append(item)
             self._cv.notify()
@@ -164,7 +174,8 @@ class ContinuousBatchingEngine:
         return np.concatenate([item["prompt"], row])
 
     def submit_stream(self, prompt: np.ndarray,
-                      cfg: Optional[GenerationConfig] = None):
+                      cfg: Optional[GenerationConfig] = None,
+                      queue: Optional[str] = None):
         """Iterator over generated tokens as they land (SSE-friendly).
         Validates and enqueues EAGERLY (so callers can still fail a
         request before committing to a streamed response); raises at the
@@ -173,7 +184,8 @@ class ContinuousBatchingEngine:
 
         q: "_queue.Queue" = _queue.Queue()
         item = self._make_item(prompt, cfg, q.put,
-                               on_done=lambda: q.put(_STREAM_END))
+                               on_done=lambda: q.put(_STREAM_END),
+                               queue=queue)
         with self._cv:
             self._queue.append(item)
             self._cv.notify()
@@ -196,7 +208,7 @@ class ContinuousBatchingEngine:
 
         return _tokens()
 
-    def _make_item(self, prompt, cfg, on_token, on_done=None):
+    def _make_item(self, prompt, cfg, on_token, on_done=None, queue=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = cfg or GenerationConfig()
         seq_len = self.gen.config.seq_len
@@ -224,7 +236,8 @@ class ContinuousBatchingEngine:
                     "smaller chunk size or shorter prompt")
         return {"prompt": prompt, "cfg": cfg, "tokens": [],
                 "done": _DoneEvent(on_done), "error": None,
-                "on_token": on_token, "cancelled": False}
+                "on_token": on_token, "cancelled": False,
+                "queue": queue or "default"}
 
     def shutdown(self):
         with self._cv:
@@ -244,10 +257,12 @@ class ContinuousBatchingEngine:
         if self._packed is not None and len(self._queue) >= 2:
             free = [r for r in range(self.B) if not self._active[r]]
             take, total = [], 0
-            while (self._queue and len(take) < len(free) and
-                   total + len(self._queue[0]["prompt"]) <=
-                   self._packed.total_bucket):
-                item = self._queue.pop(0)
+            while len(take) < len(free):
+                nxt = self._queue.peek()
+                if nxt is None or total + len(nxt["prompt"]) > \
+                        self._packed.total_bucket:
+                    break
+                item = self._queue.popleft()
                 take.append(item)
                 total += len(item["prompt"])
             if len(take) >= 2:
@@ -279,11 +294,11 @@ class ContinuousBatchingEngine:
                                 self._rows[r] = None
             else:
                 # not enough for a pack: put back and fall through
-                self._queue = take + self._queue
+                self._queue.pushback(take)
         for r in range(self.B):
-            if self._active[r] or not self._queue:
+            if self._active[r] or len(self._queue) == 0:
                 continue
-            item = self._queue.pop(0)
+            item = self._queue.popleft()
             try:
                 p = item["prompt"]
                 if self._prefix is not None:
@@ -317,16 +332,15 @@ class ContinuousBatchingEngine:
     def _run(self):
         while True:
             with self._cv:
-                while not self._stop and (not self._queue and
+                while not self._stop and (len(self._queue) == 0 and
                                           not self._active.any()):
                     self._cv.wait()
                 if self._stop:
                     # fail pending work so no submitter deadlocks
                     err = RuntimeError("engine shut down")
-                    for item in self._queue:
+                    for item in self._queue.drain():
                         item["error"] = err
                         item["done"].set()
-                    self._queue = []
                     for r in range(self.B):
                         if self._active[r]:
                             self._rows[r]["error"] = err

@@ -1,0 +1,198 @@
+"""Request scheduling policies for the serving engine.
+
+Analog of ref ``examples/llm_serving/service/scheduler.py`` (270 LoC:
+WeightedRoundRobin via an "hourglass" event list, NestedScheduler,
+FrontQueueScheduler).  Redesigned around virtual-time fair queueing —
+the textbook SFQ formulation gives the same service proportions as the
+reference's hourglass construction with far less machinery: each item
+is tagged ``max(V, last_tag(queue)) + 1/weight`` at arrival and pops in
+tag order, so backlogged queues share throughput in weight ratio and an
+idle queue neither starves others nor banks credit.
+
+All schedulers speak the engine's queue protocol — ``append(item)``,
+``popleft()``, ``peek()``, ``pushback(items)``, ``drain()``,
+``__len__`` — so ``ContinuousBatchingEngine(scheduler=...)`` swaps
+policies without touching admission logic.  Items are the engine's
+request dicts; the policy key is ``item.get("queue", "default")``.
+"""
+import heapq
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["FIFOQueue", "WeightedFairQueue", "NestedScheduler"]
+
+# a WeightedFairQueue's per-queue tag dict is pruned when it outgrows
+# this (entries at or below virtual time are semantically dead weight)
+_TAG_PRUNE_THRESHOLD = 1024
+
+
+def _queue_name(item) -> str:
+    return item.get("queue", "default") if isinstance(item, dict) \
+        else "default"
+
+
+class _FrontedQueue:
+    """Shared protocol shell: a front deque for pushed-back items (the
+    packed-admission path pops a prefix speculatively and may return
+    it) ahead of whatever ordering the policy implements via
+    ``_pop_policy`` / ``_peek_policy`` / ``_drain_policy`` /
+    ``_len_policy``."""
+
+    def __init__(self):
+        self._front = deque()
+
+    def pushback(self, items: Iterable):
+        """Return borrowed items to the FRONT, preserving their order,
+        ahead of all policy-ordered work."""
+        for item in reversed(list(items)):
+            self._front.appendleft(item)
+
+    def popleft(self):
+        if self._front:
+            return self._front.popleft()
+        return self._pop_policy()
+
+    def peek(self):
+        if self._front:
+            return self._front[0]
+        return self._peek_policy()
+
+    def drain(self) -> List:
+        out = list(self._front)
+        self._front.clear()
+        out.extend(self._drain_policy())
+        return out
+
+    def __len__(self):
+        return len(self._front) + self._len_policy()
+
+
+class FIFOQueue(_FrontedQueue):
+    """The engine's default policy: one global arrival-order queue."""
+
+    def __init__(self):
+        super().__init__()
+        self._q = deque()
+
+    def append(self, item):
+        self._q.append(item)
+
+    def _pop_policy(self):
+        return self._q.popleft()
+
+    def _peek_policy(self):
+        return self._q[0] if self._q else None
+
+    def _drain_policy(self) -> List:
+        out = list(self._q)
+        self._q.clear()
+        return out
+
+    def _len_policy(self):
+        return len(self._q)
+
+
+class WeightedFairQueue(_FrontedQueue):
+    """Start-time fair queueing across named queues.
+
+    ``weights``: queue name -> positive weight; unknown queues get
+    ``default_weight``.  Under backlog, queue throughput converges to
+    the weight ratio; within a queue, FIFO order is preserved.
+    """
+
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 default_weight: float = 1.0):
+        super().__init__()
+        self.weights = dict(weights or {})
+        self.default_weight = float(default_weight)
+        if any(w <= 0 for w in self.weights.values()) or \
+                default_weight <= 0:
+            raise ValueError("weights must be positive")
+        self._heap: List = []         # (tag, seq, item)
+        self._seq = 0                 # FIFO tie-break + within-queue order
+        self._vtime = 0.0             # virtual time = tag of last pop
+        self._last_tag: Dict[str, float] = {}
+
+    def append(self, item):
+        name = _queue_name(item)
+        start = max(self._vtime, self._last_tag.get(name, 0.0))
+        tag = start + 1.0 / self.weights.get(name, self.default_weight)
+        self._last_tag[name] = tag
+        heapq.heappush(self._heap, (tag, self._seq, item))
+        self._seq += 1
+
+    def _pop_policy(self):
+        tag, _seq, item = heapq.heappop(self._heap)
+        self._vtime = tag
+        if len(self._last_tag) > _TAG_PRUNE_THRESHOLD:
+            # entries at/below vtime cannot affect any future tag
+            # (start = max(vtime, last_tag)); pruning them bounds
+            # memory against clients inventing unique queue names
+            self._last_tag = {k: v for k, v in self._last_tag.items()
+                              if v > self._vtime}
+        return item
+
+    def _peek_policy(self):
+        return self._heap[0][2] if self._heap else None
+
+    def _drain_policy(self) -> List:
+        out = [it for _, _, it in sorted(self._heap)]
+        self._heap.clear()
+        return out
+
+    def _len_policy(self):
+        return len(self._heap)
+
+
+class NestedScheduler(_FrontedQueue):
+    """Two-level policy (ref NestedScheduler): an outer scheduler picks
+    the GROUP, a per-group inner scheduler picks within it.
+
+    The group key is ``item["group"]`` when present, else the prefix of
+    the queue name before "/" — so the engine/controller API (which
+    only carries ``queue``) drives both levels with composite names
+    like ``"paid/alice"``: outer fairness across ``paid`` vs ``free``,
+    inner policy (default FIFO) across the full names within a group.
+    """
+
+    def __init__(self, outer: Optional[WeightedFairQueue] = None,
+                 inner_factory=FIFOQueue):
+        super().__init__()
+        self._outer = outer or WeightedFairQueue()
+        self._inner: Dict[str, object] = {}
+        self._inner_factory = inner_factory
+
+    @staticmethod
+    def _group(item) -> str:
+        if isinstance(item, dict) and "group" in item:
+            return item["group"]
+        return _queue_name(item).split("/", 1)[0]
+
+    def append(self, item):
+        g = self._group(item)
+        if g not in self._inner:
+            self._inner[g] = self._inner_factory()
+        self._inner[g].append(item)
+        # the outer queue holds one token per queued item, tagged with
+        # the group name so fair service applies across groups
+        self._outer.append({"queue": g})
+
+    def _pop_policy(self):
+        token = self._outer.popleft()
+        return self._inner[token["queue"]].popleft()
+
+    def _peek_policy(self):
+        token = self._outer.peek()
+        if token is None:
+            return None
+        return self._inner[token["queue"]].peek()
+
+    def _drain_policy(self) -> List:
+        out = []
+        while len(self._outer):
+            token = self._outer.popleft()
+            out.append(self._inner[token["queue"]].popleft())
+        return out
+
+    def _len_policy(self):
+        return len(self._outer)
