@@ -229,16 +229,26 @@ class Controller:
             raise KeyError(f"unknown model {name!r}; "
                            f"registered: {self.list_models()}")
         prompt_ids = np.asarray(request["prompt_ids"], np.int32)
+        queue = request.get("queue")
+        if queue is not None and (not isinstance(queue, str) or
+                                  len(queue) > 64):
+            # untrusted input headed for scheduler dict keys: reject
+            # non-strings (unhashable lists would 500) and cap length.
+            # Validated here — shared by BOTH paths — even though only
+            # the streaming engine applies the policy today.
+            raise ValueError("queue must be a string of <= 64 chars")
         cfg = GenerationConfig(
             max_new_tokens=int(request.get("max_new_tokens", 32)),
             temperature=float(request.get("temperature", 1.0)),
             top_k=int(request.get("top_k", 0)),
             do_sample=bool(request.get("do_sample", False)),
             eos_token_id=request.get("eos_token_id"))
-        return self._pick_replica(name), prompt_ids, cfg
+        return self._pick_replica(name), prompt_ids, cfg, queue
 
     def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        replica, prompt_ids, cfg = self._parse_request(request)
+        # the "queue" field is validated by _parse_request but applies
+        # to the streaming engine only; the batched path coalesces FIFO
+        replica, prompt_ids, cfg, _queue = self._parse_request(request)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None]
         outs = replica.batcher.submit(list(prompt_ids), cfg)
@@ -249,17 +259,11 @@ class Controller:
         the replica's continuous-batching engine, so concurrent streams
         share decode ticks).  Yields ints; the full row is
         prompt + yielded tokens."""
-        replica, prompt_ids, cfg = self._parse_request(request)
+        replica, prompt_ids, cfg, queue = self._parse_request(request)
         if prompt_ids.ndim > 1 and prompt_ids.shape[0] != 1:
             raise ValueError(
                 "streaming accepts exactly one prompt per request; got "
                 f"{prompt_ids.shape[0]} rows")
-        queue = request.get("queue")
-        if queue is not None and (not isinstance(queue, str) or
-                                  len(queue) > 64):
-            # untrusted input headed for scheduler dict keys: reject
-            # non-strings (unhashable lists would 500) and cap length
-            raise ValueError("queue must be a string of <= 64 chars")
         return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg,
                                             queue=queue)
 

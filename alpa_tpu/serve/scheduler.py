@@ -177,9 +177,19 @@ class NestedScheduler(_FrontedQueue):
         # the group name so fair service applies across groups
         self._outer.append({"queue": g})
 
+    def _pop_from_group(self, g: str):
+        item = self._inner[g].popleft()
+        if len(self._inner[g]) == 0:
+            # drop drained inner queues: group names come from
+            # untrusted queue fields, and an entry per ever-seen name
+            # would grow forever (same threat WeightedFairQueue prunes
+            # _last_tag against)
+            del self._inner[g]
+        return item
+
     def _pop_policy(self):
         token = self._outer.popleft()
-        return self._inner[token["queue"]].popleft()
+        return self._pop_from_group(token["queue"])
 
     def _peek_policy(self):
         token = self._outer.peek()
@@ -191,7 +201,7 @@ class NestedScheduler(_FrontedQueue):
         out = []
         while len(self._outer):
             token = self._outer.popleft()
-            out.append(self._inner[token["queue"]].popleft())
+            out.append(self._pop_from_group(token["queue"]))
         return out
 
     def _len_policy(self):

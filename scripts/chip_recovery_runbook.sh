@@ -58,4 +58,10 @@ leg flash-blocks     timeout 600 python scripts/flash_longseq_bench.py blocks
 #      peak_bytes_in_use per gated rung (VERDICT r4 next #8) — its own
 #      probe-between-rungs discipline inside
 leg hbm-check        timeout 1800 python scripts/hbm_estimator_check.py
+#   7. MFU breakdown: nested sub-program timings attribute step time to
+#      forward / lm-head+CE / backward / optimizer vs a pure-matmul
+#      ceiling (VERDICT r4 next #2's profile-backed breakdown).  Budget
+#      covers the script's internal worst case (5 x (probe + 600 s
+#      child)); the script also flushes its JSON after every leg.
+leg mfu-breakdown    timeout 4200 python scripts/mfu_breakdown.py
 echo "=== runbook complete" | tee -a "$OUT"
