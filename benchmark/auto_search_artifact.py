@@ -104,6 +104,10 @@ def main():
                     help="pod-scale search: 8 hosts x 8 devices, bigger "
                     "global batch (the reference's recorded GPT-39B "
                     "solution ran at 64 GPUs, suite_auto_gpt.py:80-84)")
+    ap.add_argument("--pod4", action="store_true",
+                    help="4 hosts x 8 devices (the reference's recorded "
+                    "GPT-15B solution ran at 32 GPUs: 4 stages x (1,8), "
+                    "suite_auto_gpt.py:75-79)")
     args = ap.parse_args()
 
     from alpa_tpu.platform import pin_cpu_platform
@@ -112,6 +116,21 @@ def main():
     from alpa_tpu.mesh_profiling import (analytic_calibration,
                                          set_global_calibration)
 
+    if args.pod4:
+        out = args.out or DEFAULT_OUT.format(model=args.model).replace(
+            "_8dev", "_4x8dev")
+        set_global_calibration(analytic_calibration("v5e"))
+        plan = search_gpt_plan(args.model, n_devices=32, num_hosts=4,
+                               batch_size=128, num_micro_batches=16,
+                               layer_num=16)
+        plan["cost_basis"] = "analytic-v5e"
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump({"analytic_v5e_4x8": plan}, f, indent=1)
+        print(json.dumps({"out": out,
+                          "plan": plan["forward_stage_layer_ids"],
+                          "submeshes": plan["submesh_shapes"]}))
+        return
     if args.pod:
         out = args.out or DEFAULT_OUT.format(model=args.model).replace(
             "_8dev", "_8x8dev")

@@ -72,3 +72,26 @@ def test_pod_scale_39b_plan_structurally_sane():
     assert plan["num_stages"] >= 4
     # no cross-host tensor parallelism: every stage mesh is within-host
     assert all(h == 1 and d <= 8 for h, d in plan["submesh_shapes"])
+
+
+POD4_ARTIFACT = os.path.join(REPO, "benchmark", "results",
+                             "auto_plan_gpt15B_4x8dev.json")
+
+
+@pytest.mark.skipif(not os.path.exists(POD4_ARTIFACT),
+                    reason="no committed 4x8 plan artifact")
+def test_15b_4x8_plan_structurally_sane():
+    """The recorded GPT-15B 4x8 solution (the reference's published
+    32-GPU case is 4 balanced stages x (1,8), suite_auto_gpt.py:75-79;
+    the analytic v5e ladder rationally prefers deeper/narrower — see
+    test_stage_dp_validation for the measured-like equivalence): stages
+    partition the 16 auto layers near-uniformly, submeshes cover all 32
+    devices within hosts, and no mega-stage exists."""
+    with open(POD4_ARTIFACT, encoding="utf-8") as f:
+        plan = json.load(f)["analytic_v5e_4x8"]
+    ids = plan["forward_stage_layer_ids"]
+    flat = [i for stage in ids for i in stage]
+    assert flat == list(range(plan["num_layers"]))
+    assert sum(h * d for h, d in plan["submesh_shapes"]) == 32
+    assert all(h == 1 and d <= 8 for h, d in plan["submesh_shapes"])
+    assert max(len(s) for s in ids) <= 3, ids
