@@ -39,23 +39,36 @@ class RequestBatcher:
     batch runs to the max, each request is truncated to its own)."""
 
     def __init__(self, generator: Generator, max_batch: int = 8,
-                 max_wait_ms: float = 2.0, prefix=None):
+                 max_wait_ms: float = 2.0, prefix=None, scheduler=None):
         self.generator = generator
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
         # shared system-prompt handle: prompts are suffixes over it, in
         # BOTH the batched and streaming paths (same request semantics)
         self.prefix = prefix
-        self._queue: List[dict] = []
+        if scheduler is None:
+            from alpa_tpu.serve.scheduler import FIFOQueue
+            scheduler = FIFOQueue()
+        for method in ("append", "take", "drain", "__len__"):
+            if not hasattr(scheduler, method):
+                # fail at REGISTRATION, loudly: a protocol gap surfacing
+                # inside the worker thread would kill it and hang every
+                # submit() forever
+                raise TypeError(
+                    f"scheduler {type(scheduler).__name__} lacks "
+                    f"{method}(); see serve.scheduler's queue protocol")
+        self._queue = scheduler
         self._cv = threading.Condition()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
         self.batches_run = 0          # introspection for tests
 
     def submit(self, prompts: List[np.ndarray],
-               cfg: GenerationConfig) -> List[np.ndarray]:
+               cfg: GenerationConfig,
+               queue: Optional[str] = None) -> List[np.ndarray]:
         item = {"prompts": prompts, "cfg": cfg,
-                "done": threading.Event(), "result": None, "error": None}
+                "done": threading.Event(), "result": None, "error": None,
+                "queue": queue or "default"}
         with self._cv:
             self._queue.append(item)
             self._cv.notify()
@@ -73,28 +86,49 @@ class RequestBatcher:
         import time
         while True:
             with self._cv:
-                while not self._queue:
+                while len(self._queue) == 0:
                     self._cv.wait()
                 # small window lets concurrent arrivals coalesce
                 deadline = time.monotonic() + self.max_wait_s
             while time.monotonic() < deadline:
                 time.sleep(self.max_wait_s / 4)
             with self._cv:
-                if not self._queue:
+                if len(self._queue) == 0:
                     continue
-                key = self._group_key(self._queue[0]["cfg"])
-                batch, rest, n = [], [], 0
-                for item in self._queue:
-                    fits = n + len(item["prompts"]) <= self.max_batch
+                # selective take in POLICY order (FIFO default): the
+                # head item picks the sampling-settings group,
+                # compatible items join; skipped items stay in the
+                # scheduler with their original priority (fairness
+                # neither freezes nor re-tags — scheduler.take's
+                # contract)
+                state = {"key": None, "n": 0}
+
+                def selector(item, state=state):
+                    if state["key"] is None:
+                        state["key"] = self._group_key(item["cfg"])
+                    fits = state["n"] + len(item["prompts"]) <= \
+                        self.max_batch
                     # an oversized request runs alone rather than
                     # starving (its batch is just bigger)
-                    if (self._group_key(item["cfg"]) == key and
-                            (fits or not batch)):
-                        batch.append(item)
-                        n += len(item["prompts"])
-                    else:
-                        rest.append(item)
-                self._queue = rest
+                    if (self._group_key(item["cfg"]) == state["key"]
+                            and (fits or state["n"] == 0)):
+                        state["n"] += len(item["prompts"])
+                        return "take"
+                    return "skip"
+
+                try:
+                    batch = self._queue.take(selector)
+                except Exception as e:  # pylint: disable=broad-except
+                    # a faulty custom scheduler must fail REQUESTS, not
+                    # the worker thread (a dead thread hangs every
+                    # later submit() silently)
+                    logger.exception("scheduler.take failed")
+                    for item in self._queue.drain():
+                        item["error"] = e
+                        item["done"].set()
+                    continue
+                if not batch:
+                    continue
             try:
                 prompts = [p for it in batch for p in it["prompts"]]
                 run_cfg = dataclasses.replace(
@@ -126,7 +160,9 @@ class _Replica:
     def __init__(self, generator: Generator, prefix=None,
                  scheduler_factory=None):
         self.generator = generator
-        self.batcher = RequestBatcher(generator, prefix=prefix)
+        self.batcher = RequestBatcher(
+            generator, prefix=prefix,
+            scheduler=scheduler_factory() if scheduler_factory else None)
         self.prefix = prefix
         self.scheduler_factory = scheduler_factory
         self._engine = None
@@ -168,10 +204,11 @@ class Controller:
         replicas of one model must register the SAME prefix: round-robin
         dispatch must not change what prompt_ids mean.
 
-        ``scheduler_factory``: builds this replica's engine admission
-        policy (``serve.scheduler``, e.g.
-        ``lambda: WeightedFairQueue({"paid": 4})``); streamed requests
-        carry a ``"queue"`` field to pick their named queue."""
+        ``scheduler_factory``: builds this replica's admission policy
+        (``serve.scheduler``, e.g.
+        ``lambda: WeightedFairQueue({"paid": 4})``) — one instance for
+        the batcher and one for the streaming engine; requests carry a
+        ``"queue"`` field to pick their named queue on either path."""
         prefix_ids = (None if prefix_ids is None
                       else np.asarray(prefix_ids, np.int32).reshape(-1))
 
@@ -233,9 +270,7 @@ class Controller:
         if queue is not None and (not isinstance(queue, str) or
                                   len(queue) > 64):
             # untrusted input headed for scheduler dict keys: reject
-            # non-strings (unhashable lists would 500) and cap length.
-            # Validated here — shared by BOTH paths — even though only
-            # the streaming engine applies the policy today.
+            # non-strings (unhashable lists would 500) and cap length
             raise ValueError("queue must be a string of <= 64 chars")
         cfg = GenerationConfig(
             max_new_tokens=int(request.get("max_new_tokens", 32)),
@@ -246,12 +281,10 @@ class Controller:
         return self._pick_replica(name), prompt_ids, cfg, queue
 
     def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        # the "queue" field is validated by _parse_request but applies
-        # to the streaming engine only; the batched path coalesces FIFO
-        replica, prompt_ids, cfg, _queue = self._parse_request(request)
+        replica, prompt_ids, cfg, queue = self._parse_request(request)
         if prompt_ids.ndim == 1:
             prompt_ids = prompt_ids[None]
-        outs = replica.batcher.submit(list(prompt_ids), cfg)
+        outs = replica.batcher.submit(list(prompt_ids), cfg, queue=queue)
         return {"output_ids": [o.tolist() for o in outs]}
 
     def completions_stream(self, request: Dict[str, Any]):

@@ -9,11 +9,21 @@ is tagged ``max(V, last_tag(queue)) + 1/weight`` at arrival and pops in
 tag order, so backlogged queues share throughput in weight ratio and an
 idle queue neither starves others nor banks credit.
 
-All schedulers speak the engine's queue protocol — ``append(item)``,
-``popleft()``, ``peek()``, ``pushback(items)``, ``drain()``,
-``__len__`` — so ``ContinuousBatchingEngine(scheduler=...)`` swaps
-policies without touching admission logic.  Items are the engine's
-request dicts; the policy key is ``item.get("queue", "default")``.
+All schedulers speak the engine's queue protocol:
+
+* ``append(item)`` — enqueue (policy key: ``item.get("queue")``).
+* ``popleft()`` / ``peek()`` — serve / inspect the policy head.
+* ``take(selector)`` — SELECTIVE service in policy order:
+  ``selector(item)`` returns ``"take"`` (remove + return), ``"skip"``
+  (leave in place, priority untouched), or ``"stop"``.  This is how
+  the request batcher forms sampling-compatible batches without
+  destroying the policy state: skipped items keep their original
+  virtual-time tags, and only actually-taken items advance service.
+* ``pushback(items)`` — return items popped moments ago to the FRONT
+  (the engine's speculative packed-admission path; the hold lasts one
+  engine tick, so front-of-queue semantics are exact enough there).
+* ``drain()`` — destructive empty-out in policy order (shutdown).
+* ``__len__``.
 """
 import heapq
 from collections import deque
@@ -32,10 +42,9 @@ def _queue_name(item) -> str:
 
 
 class _FrontedQueue:
-    """Shared protocol shell: a front deque for pushed-back items (the
-    packed-admission path pops a prefix speculatively and may return
-    it) ahead of whatever ordering the policy implements via
-    ``_pop_policy`` / ``_peek_policy`` / ``_drain_policy`` /
+    """Shared protocol shell: a front deque for pushed-back items ahead
+    of whatever ordering the policy implements via ``_pop_policy`` /
+    ``_peek_policy`` / ``_take_policy`` / ``_drain_policy`` /
     ``_len_policy``."""
 
     def __init__(self):
@@ -56,6 +65,43 @@ class _FrontedQueue:
         if self._front:
             return self._front[0]
         return self._peek_policy()
+
+    @staticmethod
+    def _take_from_deque(q: deque, selector, taken: List) -> deque:
+        """Run the selector loop over a deque; returns the kept deque
+        (original order) and appends taken items.  Shared by the front
+        pass and FIFO's policy pass so stop/skip semantics cannot
+        drift.  A 'stop' is recorded by leaving ``q`` non-empty."""
+        kept = deque()
+        while q:
+            item = q.popleft()
+            decision = selector(item)
+            if decision == "take":
+                taken.append(item)
+            elif decision == "skip":
+                kept.append(item)
+            else:
+                kept.append(item)
+                break
+        kept.extend(q)
+        return kept
+
+    def take(self, selector) -> List:
+        """Pop items in policy order under ``selector`` decisions (see
+        module docstring).  Front items are offered first."""
+        taken = []
+        stopped = [False]
+
+        def wrapped(item):
+            decision = selector(item)
+            if decision == "stop":
+                stopped[0] = True
+            return decision
+
+        self._front = self._take_from_deque(self._front, wrapped, taken)
+        if not stopped[0]:
+            taken.extend(self._take_policy(wrapped))
+        return taken
 
     def drain(self) -> List:
         out = list(self._front)
@@ -82,6 +128,11 @@ class FIFOQueue(_FrontedQueue):
 
     def _peek_policy(self):
         return self._q[0] if self._q else None
+
+    def _take_policy(self, selector) -> List:
+        taken = []
+        self._q = self._take_from_deque(self._q, selector, taken)
+        return taken
 
     def _drain_policy(self) -> List:
         out = list(self._q)
@@ -110,7 +161,7 @@ class WeightedFairQueue(_FrontedQueue):
             raise ValueError("weights must be positive")
         self._heap: List = []         # (tag, seq, item)
         self._seq = 0                 # FIFO tie-break + within-queue order
-        self._vtime = 0.0             # virtual time = tag of last pop
+        self._vtime = 0.0             # virtual time of last SERVICE
         self._last_tag: Dict[str, float] = {}
 
     def append(self, item):
@@ -121,19 +172,42 @@ class WeightedFairQueue(_FrontedQueue):
         heapq.heappush(self._heap, (tag, self._seq, item))
         self._seq += 1
 
-    def _pop_policy(self):
-        tag, _seq, item = heapq.heappop(self._heap)
-        self._vtime = tag
+    def _advance(self, tag):
+        self._vtime = max(self._vtime, tag)
         if len(self._last_tag) > _TAG_PRUNE_THRESHOLD:
             # entries at/below vtime cannot affect any future tag
             # (start = max(vtime, last_tag)); pruning them bounds
             # memory against clients inventing unique queue names
             self._last_tag = {k: v for k, v in self._last_tag.items()
                               if v > self._vtime}
+
+    def _pop_policy(self):
+        tag, _seq, item = heapq.heappop(self._heap)
+        self._advance(tag)
         return item
 
     def _peek_policy(self):
         return self._heap[0][2] if self._heap else None
+
+    def _take_policy(self, selector) -> List:
+        taken = []
+        kept = []
+        entries = sorted(self._heap)
+        for i, entry in enumerate(entries):
+            tag, _seq, item = entry
+            decision = selector(item)
+            if decision == "take":
+                taken.append(item)
+                self._advance(tag)
+            elif decision == "skip":
+                kept.append(entry)
+            else:
+                # skipped entries keep their tags; unvisited tail too
+                kept.extend(entries[i:])
+                break
+        self._heap = kept
+        heapq.heapify(self._heap)
+        return taken
 
     def _drain_policy(self) -> List:
         out = [it for _, _, it in sorted(self._heap)]
@@ -197,10 +271,37 @@ class NestedScheduler(_FrontedQueue):
             return None
         return self._inner[token["queue"]].peek()
 
+    def _take_policy(self, selector) -> List:
+        """Offer each group's inner HEAD in outer policy order.  A
+        'skip' on a group's head skips the whole group for this take
+        (deeper inner items are unreachable without consuming the
+        head); taken heads consume their outer token (real service),
+        skipped groups' tokens stay untouched."""
+        taken = []
+        skip_groups = set()
+        stop = [False]
+
+        def outer_selector(token):
+            g = token["queue"]
+            if stop[0] or g in skip_groups:
+                return "stop" if stop[0] else "skip"
+            head = self._inner[g].peek()
+            decision = selector(head)
+            if decision == "take":
+                taken.append(self._pop_from_group(g))
+                return "take"
+            if decision == "skip":
+                skip_groups.add(g)
+                return "skip"
+            stop[0] = True
+            return "stop"
+
+        self._outer.take(outer_selector)
+        return taken
+
     def _drain_policy(self) -> List:
         out = []
-        while len(self._outer):
-            token = self._outer.popleft()
+        for token in self._outer.drain():
             out.append(self._pop_from_group(token["queue"]))
         return out
 

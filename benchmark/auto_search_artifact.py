@@ -116,35 +116,29 @@ def main():
     from alpa_tpu.mesh_profiling import (analytic_calibration,
                                          set_global_calibration)
 
-    if args.pod4:
+    def pod_case(suffix, key, num_hosts, num_micro_batches):
         out = args.out or DEFAULT_OUT.format(model=args.model).replace(
-            "_8dev", "_4x8dev")
+            "_8dev", suffix)
         set_global_calibration(analytic_calibration("v5e"))
-        plan = search_gpt_plan(args.model, n_devices=32, num_hosts=4,
-                               batch_size=128, num_micro_batches=16,
+        plan = search_gpt_plan(args.model, n_devices=8 * num_hosts,
+                               num_hosts=num_hosts, batch_size=128,
+                               num_micro_batches=num_micro_batches,
                                layer_num=16)
         plan["cost_basis"] = "analytic-v5e"
-        os.makedirs(os.path.dirname(out), exist_ok=True)
+        os.makedirs(os.path.dirname(os.path.abspath(out)), exist_ok=True)
         with open(out, "w", encoding="utf-8") as f:
-            json.dump({"analytic_v5e_4x8": plan}, f, indent=1)
+            json.dump({key: plan}, f, indent=1)
         print(json.dumps({"out": out,
                           "plan": plan["forward_stage_layer_ids"],
                           "submeshes": plan["submesh_shapes"]}))
+
+    if args.pod4:
+        # the reference's recorded GPT-15B solution ran at 32 GPUs
+        pod_case("_4x8dev", "analytic_v5e_4x8", 4, 16)
         return
     if args.pod:
-        out = args.out or DEFAULT_OUT.format(model=args.model).replace(
-            "_8dev", "_8x8dev")
-        set_global_calibration(analytic_calibration("v5e"))
-        plan = search_gpt_plan(args.model, n_devices=64, num_hosts=8,
-                               batch_size=128, num_micro_batches=32,
-                               layer_num=16)
-        plan["cost_basis"] = "analytic-v5e"
-        os.makedirs(os.path.dirname(out), exist_ok=True)
-        with open(out, "w", encoding="utf-8") as f:
-            json.dump({"analytic_v5e_8x8": plan}, f, indent=1)
-        print(json.dumps({"out": out,
-                          "plan": plan["forward_stage_layer_ids"],
-                          "submeshes": plan["submesh_shapes"]}))
+        # the reference's recorded GPT-39B solution ran at 64 GPUs
+        pod_case("_8x8dev", "analytic_v5e_8x8", 8, 32)
         return
     out = args.out or DEFAULT_OUT.format(model=args.model)
 
