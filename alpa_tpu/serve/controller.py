@@ -114,6 +114,10 @@ class RequestBatcher:
                             and (fits or state["n"] == 0)):
                         state["n"] += len(item["prompts"])
                         return "take"
+                    if state["n"] >= self.max_batch:
+                        # batch full: nothing later can join — stop
+                        # scanning the backlog
+                        return "stop"
                     return "skip"
 
                 try:

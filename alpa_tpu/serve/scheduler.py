@@ -71,11 +71,22 @@ class _FrontedQueue:
         """Run the selector loop over a deque; returns the kept deque
         (original order) and appends taken items.  Shared by the front
         pass and FIFO's policy pass so stop/skip semantics cannot
-        drift.  A 'stop' is recorded by leaving ``q`` non-empty."""
+        drift.  A selector exception keeps the in-flight item in the
+        kept deque (nothing is lost)."""
         kept = deque()
         while q:
             item = q.popleft()
-            decision = selector(item)
+            try:
+                decision = selector(item)
+            except Exception:
+                # restore IN PLACE: the caller's reference to q (whose
+                # reassignment never happens on a raise) must still
+                # hold every non-taken item
+                kept.append(item)
+                kept.extend(q)
+                q.clear()
+                q.extend(kept)
+                raise
             if decision == "take":
                 taken.append(item)
             elif decision == "skip":
@@ -88,7 +99,11 @@ class _FrontedQueue:
 
     def take(self, selector) -> List:
         """Pop items in policy order under ``selector`` decisions (see
-        module docstring).  Front items are offered first."""
+        module docstring).  Front items are offered first.
+
+        Exception safety: if the selector raises, items taken so far
+        return to the FRONT and no item is lost — a faulty policy
+        callback must never strand a request outside the queue."""
         taken = []
         stopped = [False]
 
@@ -98,9 +113,17 @@ class _FrontedQueue:
                 stopped[0] = True
             return decision
 
-        self._front = self._take_from_deque(self._front, wrapped, taken)
-        if not stopped[0]:
-            taken.extend(self._take_policy(wrapped))
+        try:
+            self._front = self._take_from_deque(self._front, wrapped,
+                                                taken)
+            if not stopped[0]:
+                # _take_policy appends into the SHARED list so a raise
+                # mid-policy still leaves every taken item reachable
+                # for the pushback below
+                self._take_policy(wrapped, taken)
+        except Exception:
+            self.pushback(taken)
+            raise
         return taken
 
     def drain(self) -> List:
@@ -129,10 +152,8 @@ class FIFOQueue(_FrontedQueue):
     def _peek_policy(self):
         return self._q[0] if self._q else None
 
-    def _take_policy(self, selector) -> List:
-        taken = []
+    def _take_policy(self, selector, taken: List):
         self._q = self._take_from_deque(self._q, selector, taken)
-        return taken
 
     def _drain_policy(self) -> List:
         out = list(self._q)
@@ -189,22 +210,30 @@ class WeightedFairQueue(_FrontedQueue):
     def _peek_policy(self):
         return self._heap[0][2] if self._heap else None
 
-    def _take_policy(self, selector) -> List:
-        taken = []
+    def _take_policy(self, selector, taken: List):
         kept = []
         entries = sorted(self._heap)
-        for i, entry in enumerate(entries):
-            tag, _seq, item = entry
-            decision = selector(item)
-            if decision == "take":
-                taken.append(item)
-                self._advance(tag)
-            elif decision == "skip":
-                kept.append(entry)
-            else:
-                # skipped entries keep their tags; unvisited tail too
-                kept.extend(entries[i:])
-                break
+        try:
+            for i, entry in enumerate(entries):
+                tag, _seq, item = entry
+                decision = selector(item)
+                if decision == "take":
+                    taken.append(item)
+                    self._advance(tag)
+                elif decision == "skip":
+                    kept.append(entry)
+                else:
+                    # skipped entries keep their tags; unvisited tail
+                    kept.extend(entries[i:])
+                    break
+        except Exception:
+            # the in-flight entry (selector raised) and the unvisited
+            # tail stay; entries taken so far are removed from the heap
+            # (take() pushes the taken ITEMS back to the front)
+            kept.extend(entries[i:])
+            self._heap = kept
+            heapq.heapify(self._heap)
+            raise
         self._heap = kept
         heapq.heapify(self._heap)
         return taken
@@ -271,22 +300,33 @@ class NestedScheduler(_FrontedQueue):
             return None
         return self._inner[token["queue"]].peek()
 
-    def _take_policy(self, selector) -> List:
+    def _take_policy(self, selector, taken: List):
         """Offer each group's inner HEAD in outer policy order.  A
         'skip' on a group's head skips the whole group for this take
         (deeper inner items are unreachable without consuming the
         head); taken heads consume their outer token (real service),
-        skipped groups' tokens stay untouched."""
-        taken = []
+        skipped groups' tokens stay untouched.
+
+        A selector exception is captured so the outer take completes
+        cleanly (tokens for already-taken items are consumed, matching
+        the inner pops), the popped items return to the front, and the
+        error re-raises — nothing is lost."""
         skip_groups = set()
         stop = [False]
+        err: List = []
 
         def outer_selector(token):
+            if err or stop[0]:
+                return "stop"
             g = token["queue"]
-            if stop[0] or g in skip_groups:
-                return "stop" if stop[0] else "skip"
+            if g in skip_groups:
+                return "skip"
             head = self._inner[g].peek()
-            decision = selector(head)
+            try:
+                decision = selector(head)
+            except Exception as e:  # pylint: disable=broad-except
+                err.append(e)
+                return "stop"
             if decision == "take":
                 taken.append(self._pop_from_group(g))
                 return "take"
@@ -297,7 +337,10 @@ class NestedScheduler(_FrontedQueue):
             return "stop"
 
         self._outer.take(outer_selector)
-        return taken
+        if err:
+            # taken items are in the SHARED list; the caller's except
+            # path pushes them back — just surface the error
+            raise err[0]
 
     def _drain_policy(self) -> List:
         out = []

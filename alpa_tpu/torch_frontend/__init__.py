@@ -36,10 +36,10 @@ def get_mode() -> str:
 
 
 def functionalize(module, concrete_args=None, split_buffers=False,
-                  dropout=None, leaf_modules=()):
+                  dropout=None, leaf_modules=(), mutable_buffers=False):
     """torch.nn.Module -> (jax_fn, params), or with ``split_buffers=True``
     (jax_fn, trainable, buffers) — see converter.functionalize (also for
-    the ``dropout`` policy and ``leaf_modules``).
+    the ``dropout`` policy, ``leaf_modules``, and ``mutable_buffers``).
 
     The mode is consulted at CALL time, so ``set_mode`` may be called
     before or after conversion: "local" runs the function under jax.jit
@@ -48,7 +48,8 @@ def functionalize(module, concrete_args=None, split_buffers=False,
     import functools
     import jax
     out = _functionalize(module, concrete_args, split_buffers,
-                         dropout=dropout, leaf_modules=leaf_modules)
+                         dropout=dropout, leaf_modules=leaf_modules,
+                         mutable_buffers=mutable_buffers)
     fn = out[0]
     jitted = jax.jit(fn)
 

@@ -224,37 +224,52 @@ def _group_norm(x, num_groups, w=None, b=None, eps=1e-5):
     return y
 
 
-def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
-                training=False, momentum=0.1, eps=1e-5):
+def _batch_stats(x):
+    """Per-channel batch mean/variance (+ channel broadcast shape and
+    count), torch BatchNorm train-mode numerics; raises torch's n<=1
+    error."""
     shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
-    if training:
-        n_per_channel = x.size // x.shape[1] if x.ndim > 1 else x.size
-        if n_per_channel <= 1:
-            # torch raises here too: var==0 would silently train on bias
-            raise ValueError(
-                "Expected more than 1 value per channel when training, "
-                f"got input size {tuple(x.shape)}")
-        # batch statistics, matching torch train-mode numerics.  The
-        # running-stat update is a side effect the functional trace cannot
-        # express, so running_mean/var stay frozen — warn when there are
-        # stats being left behind (track_running_stats=False has none).
-        if running_mean is not None:
-            warnings.warn(
-                "F.batch_norm traced with training=True: batch statistics "
-                "are used, but running-stat updates (momentum) are dropped "
-                "by the functional trace", stacklevel=2)
-        axes = (0,) + tuple(range(2, x.ndim))
-        mean = x.mean(axes)
-        var = ((x - mean.reshape(shape))**2).mean(axes)
-    else:
-        # eval-mode semantics: normalize with running statistics
-        mean, var = running_mean, running_var
+    n = x.size // x.shape[1] if x.ndim > 1 else x.size
+    if n <= 1:
+        # torch raises here too: var==0 would silently train on bias
+        raise ValueError(
+            "Expected more than 1 value per channel when training, "
+            f"got input size {tuple(x.shape)}")
+    axes = (0,) + tuple(range(2, x.ndim))
+    mean = x.mean(axes)
+    var = ((x - mean.reshape(shape)) ** 2).mean(axes)
+    return mean, var, n, shape
+
+
+def _bn_normalize(x, mean, var, weight, bias, eps, shape):
     y = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + eps)
     if weight is not None:
         y = y * weight.reshape(shape)
     if bias is not None:
         y = y + bias.reshape(shape)
     return y
+
+
+def _batch_norm(x, running_mean, running_var, weight=None, bias=None,
+                training=False, momentum=0.1, eps=1e-5):
+    if training:
+        # batch statistics, matching torch train-mode numerics.  The
+        # running-stat update is a side effect the functional trace cannot
+        # express HERE, so running_mean/var stay frozen — warn when there
+        # are stats being left behind (track_running_stats=False has
+        # none).  nn.BatchNorm* module sites get the update captured via
+        # fx_to_jax(track_buffer_updates=True) instead.
+        if running_mean is not None:
+            warnings.warn(
+                "F.batch_norm traced with training=True: batch statistics "
+                "are used, but running-stat updates (momentum) are dropped "
+                "by the functional trace", stacklevel=2)
+        mean, var, _n, shape = _batch_stats(x)
+    else:
+        # eval-mode semantics: normalize with running statistics
+        mean, var = running_mean, running_var
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return _bn_normalize(x, mean, var, weight, bias, eps, shape)
 
 
 def _torch_dtype_to_jnp(dtype):
@@ -613,7 +628,8 @@ def _convert_gpt2_block(mod, params_prefix: str):
 
 
 def fx_to_jax(gm, params: Dict[str, Any],
-              dropout_mode: str = "identity") -> Callable:
+              dropout_mode: str = "identity",
+              track_buffer_updates: bool = False) -> Callable:
     """Convert an fx.GraphModule into fn(params, *inputs, rng=None).
 
     ``params`` is used to validate at conversion time that every
@@ -627,7 +643,15 @@ def fx_to_jax(gm, params: Dict[str, Any],
       * "rng": real inverted dropout; the converted function takes a
         ``rng`` keyword (a jax PRNG key) and derives one independent key
         per site via fold_in.  Calling without ``rng`` raises.
-    Inactive sites (eval mode or p == 0) are identity either way."""
+    Inactive sites (eval mode or p == 0) are identity either way.
+
+    ``track_buffer_updates=True`` makes the converted function return
+    ``(out, buffer_updates)``: train-mode nn.BatchNorm* sites with
+    tracked running stats emit their momentum-updated
+    running_mean/running_var (+ num_batches_tracked) into the dict —
+    torch's in-place side effect, functionalized.  Callers fold the
+    updates into their buffers between steps:
+    ``buffers = {**buffers, **updates}``."""
     import torch
 
     if dropout_mode not in ("identity", "rng"):
@@ -648,6 +672,23 @@ def fx_to_jax(gm, params: Dict[str, Any],
         for n in gm.graph.nodes if n.op == "call_module"
         and not isinstance(modules[n.target], torch.nn.Dropout)
     }
+    # train-mode tracked-stats BatchNorm module sites whose running-stat
+    # updates are captured when track_buffer_updates is on (functional
+    # F.batch_norm calls keep the warn-and-freeze behavior)
+    from torch.nn.modules.batchnorm import _BatchNorm
+    bn_update_sites = {
+        n.name: n.target for n in gm.graph.nodes
+        if track_buffer_updates and n.op == "call_module"
+        and isinstance(modules.get(n.target), _BatchNorm)
+        and modules[n.target].training
+        and modules[n.target].track_running_stats
+    }
+    for target in bn_update_sites.values():
+        if modules[target].momentum is None:
+            raise NotImplementedError(
+                f"BatchNorm {target}: momentum=None (cumulative moving "
+                "average) is not supported for tracked buffer updates")
+
     # stable per-site indices for rng fold_in
     dropout_site = {
         n.name: i
@@ -672,8 +713,35 @@ def fx_to_jax(gm, params: Dict[str, Any],
         keep = jax.random.bernoulli(key, 1.0 - p_drop, x.shape)
         return jnp.where(keep, x / (1.0 - p_drop), jnp.zeros_like(x))
 
+    def _bn_with_updates(x, target, p, buf_updates):
+        """Train-mode BatchNorm with the running-stat side effect made
+        explicit: normalize with batch stats (shared _batch_stats /
+        _bn_normalize numerics) and emit the momentum-updated running
+        stats.  Reads compose through buf_updates so a weight-SHARED
+        module called at several sites compounds sequentially, exactly
+        as torch's in-place updates do."""
+        mod = modules[target]
+        pf = target + "."
+
+        def cur(key):
+            return buf_updates.get(key, p[key])
+
+        mean, var, n, shape = _batch_stats(x)
+        m = mod.momentum
+        buf_updates[pf + "running_mean"] = \
+            (1 - m) * cur(pf + "running_mean") + m * mean
+        # torch updates running_var with the UNBIASED batch variance
+        buf_updates[pf + "running_var"] = \
+            (1 - m) * cur(pf + "running_var") + m * var * (n / (n - 1))
+        nbt = pf + "num_batches_tracked"
+        if nbt in p:
+            buf_updates[nbt] = cur(nbt) + 1
+        return _bn_normalize(x, mean, var, p.get(pf + "weight"),
+                             p.get(pf + "bias"), mod.eps, shape)
+
     def fn(p, *inputs, rng=None):
         env: Dict[str, Any] = {}
+        buf_updates: Dict[str, Any] = {}
         input_iter = iter(inputs)
 
         def lookup(a):
@@ -719,11 +787,17 @@ def fx_to_jax(gm, params: Dict[str, Any],
                     env[node.name] = _apply_dropout(
                         args[0], mod.p, mod.training, node.name, rng)
                     continue
+                if node.name in bn_update_sites:
+                    env[node.name] = _bn_with_updates(
+                        args[0], node.target, p, buf_updates)
+                    continue
                 mf = module_fns[node.target]
                 kwargs = {k: lookup(v) for k, v in node.kwargs.items()}
                 env[node.name] = mf(p, *args, **kwargs)
             elif node.op == "output":
                 out = lookup(node.args[0])
+        if track_buffer_updates:
+            return out, buf_updates
         return out
 
     return fn
@@ -759,14 +833,18 @@ def _find_active_dropout(gm) -> List[str]:
 
 
 def functionalize(module, concrete_args=None, split_buffers=False,
-                  dropout=None, leaf_modules=()):
+                  dropout=None, leaf_modules=(), mutable_buffers=False):
     """torch.nn.Module -> (jax_fn, params_dict).
 
     jax_fn(params, *jax_inputs) reproduces module.forward in the module's
     CURRENT train/eval mode (ref: the functionalized nn of alpa/torch/nn/).
     Train-mode tracing warns: BatchNorm uses batch statistics (matching
     torch), but the running-stat update is a side effect the functional
-    trace drops.
+    trace drops — UNLESS ``mutable_buffers=True``, in which case the
+    converted function returns ``(out, buffer_updates)`` with the
+    momentum-updated running stats of every train-mode nn.BatchNorm*
+    (fold them in between steps: ``buffers = {**buffers, **updates}``;
+    pairs naturally with ``split_buffers=True``).
 
     ``dropout`` is the EXPLICIT policy for train-mode dropout (a
     train-mode module containing active dropout refuses to convert
@@ -789,12 +867,13 @@ def functionalize(module, concrete_args=None, split_buffers=False,
     import torch
     import torch.fx
 
-    if module.training:
+    if module.training and not mutable_buffers:
         warnings.warn(
             "functionalize: tracing a train-mode module — BatchNorm uses "
             "batch statistics but running-stat updates are dropped by "
-            "the functional trace; call .eval() first for eval "
-            "semantics", stacklevel=2)
+            "the functional trace; pass mutable_buffers=True to capture "
+            "them, or call .eval() first for eval semantics",
+            stacklevel=2)
 
     if leaf_modules:
         leaf_classes = tuple(leaf_modules)
@@ -823,7 +902,8 @@ def functionalize(module, concrete_args=None, split_buffers=False,
         k: torch_to_jax_array(v)
         for k, v in {**dict(module.state_dict())}.items()
     }
-    fn = fx_to_jax(gm, params, dropout_mode=dropout or "identity")
+    fn = fx_to_jax(gm, params, dropout_mode=dropout or "identity",
+                   track_buffer_updates=mutable_buffers)
     if split_buffers:
         pnames = {k for k, _ in module.named_parameters()}
         trainable = {k: v for k, v in params.items() if k in pnames}

@@ -305,3 +305,33 @@ class TestEngineIntegration:
 
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
+
+
+class TestTakeExceptionSafety:
+    """A faulty selector must never strand items outside the queue:
+    taken-so-far items return to the front, in-flight and unvisited
+    items stay, and the error propagates."""
+
+    @pytest.mark.parametrize("factory", [
+        FIFOQueue,
+        lambda: WeightedFairQueue({"a": 2.0}),
+        lambda: NestedScheduler(outer=WeightedFairQueue()),
+    ])
+    def test_no_item_lost_on_selector_raise(self, factory):
+        s = factory()
+        items = [{"queue": "a/x", "i": i} for i in range(6)]
+        for it in items:
+            s.append(it)
+
+        calls = [0]
+
+        def bad(item):
+            calls[0] += 1
+            if calls[0] == 3:
+                raise RuntimeError("boom")
+            return "take"
+
+        with pytest.raises(RuntimeError, match="boom"):
+            s.take(bad)
+        assert len(s) == 6
+        assert sorted(it["i"] for it in s.drain()) == list(range(6))

@@ -1,0 +1,79 @@
+"""BatchNorm running-stat updates as explicit outputs
+(``functionalize(mutable_buffers=True)``): torch's in-place side effect
+becomes a returned updates dict, matching torch's multi-step trajectory
+exactly — the last lossy train-mode semantic in the fx frontend.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+
+from alpa_tpu.torch_frontend import functionalize              # noqa: E402
+
+
+def _net():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Conv2d(3, 8, 3, padding=1),
+        torch.nn.BatchNorm2d(8),
+        torch.nn.ReLU(),
+        torch.nn.Conv2d(8, 4, 3, padding=1),
+        torch.nn.BatchNorm2d(4))
+
+
+class TestMutableBuffers:
+
+    def test_three_step_running_stats_match_torch(self):
+        tm = _net().train()
+        fn, trainable, buffers = functionalize(
+            _net().train(), split_buffers=True, mutable_buffers=True)
+
+        rng = np.random.RandomState(0)
+        for step in range(3):
+            x = rng.randn(4, 3, 6, 6).astype(np.float32)
+            want = tm(torch.tensor(x)).detach().numpy()
+            got, updates = fn({**trainable, **buffers}, jnp.asarray(x))
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=1e-4, atol=1e-4)
+            buffers = {**buffers, **updates}
+
+        for name, buf in tm.state_dict().items():
+            if "running" in name or "num_batches" in name:
+                np.testing.assert_allclose(
+                    np.asarray(buffers[name]), buf.numpy(),
+                    rtol=1e-4, atol=1e-5, err_msg=name)
+        assert int(buffers["1.num_batches_tracked"]) == 3
+
+    def test_eval_mode_emits_no_updates(self):
+        m = _net().eval()
+        fn, params = functionalize(m, mutable_buffers=True)
+        x = np.random.RandomState(1).randn(2, 3, 6, 6).astype(np.float32)
+        out, updates = fn(params, jnp.asarray(x))
+        assert updates == {}
+        want = m(torch.tensor(x)).detach().numpy()
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_updates_work_under_jit(self):
+        fn, trainable, buffers = functionalize(
+            _net().train(), split_buffers=True, mutable_buffers=True)
+        jf = jax.jit(fn)
+        x = jnp.asarray(np.random.RandomState(2)
+                        .randn(4, 3, 6, 6).astype(np.float32))
+        out, updates = jf({**trainable, **buffers}, x)
+        assert set(updates) == {
+            "1.running_mean", "1.running_var", "1.num_batches_tracked",
+            "4.running_mean", "4.running_var", "4.num_batches_tracked"}
+
+    def test_momentum_none_rejected(self):
+        m = torch.nn.Sequential(
+            torch.nn.BatchNorm1d(4, momentum=None)).train()
+        with pytest.raises(NotImplementedError, match="momentum"):
+            functionalize(m, mutable_buffers=True)
+
+
+if __name__ == "__main__":
+    pytest.main([__file__, "-x", "-q"])
