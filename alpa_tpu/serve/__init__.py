@@ -7,8 +7,8 @@ autoregressive generation engine with resident KV caches compiled per
 """
 from alpa_tpu.serve.generation import (GenerationConfig, Generator,
                                        PrefixHandle, get_model)
-from alpa_tpu.serve.controller import (Controller, RequestBatcher,
-                                       run_controller)
+from alpa_tpu.serve.controller import (Controller, ControllerServer,
+                                       RequestBatcher, run_controller)
 from alpa_tpu.serve.engine import ContinuousBatchingEngine
 from alpa_tpu.serve.hf_wrapper import WrappedInferenceModel, get_hf_model
 from alpa_tpu.serve.packed import PackedPrefill, pack_prompts
