@@ -1,0 +1,40 @@
+"""The automated chip-recovery path must not rot: every script the
+runbook (and the watch loop that fires it) invokes exists, parses, and
+the python ones compile.  A rename breaking this chain would silently
+cost an entire round's bench window (the relay wedge playbook depends
+on unattended recovery)."""
+import os
+import py_compile
+import re
+import subprocess
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _referenced_scripts(sh_path):
+    with open(sh_path, encoding="utf-8") as f:
+        text = f.read()
+    return sorted(set(re.findall(r"(scripts/[\w./]+\.(?:py|sh))", text)))
+
+
+def test_runbook_and_watch_reference_existing_scripts():
+    for sh in ("scripts/chip_recovery_runbook.sh",
+               "scripts/chip_watch.sh"):
+        path = os.path.join(REPO, sh)
+        assert os.path.exists(path), sh
+        # shell parses
+        subprocess.run(["bash", "-n", path], check=True)
+        for ref in _referenced_scripts(path):
+            full = os.path.join(REPO, ref)
+            assert os.path.exists(full), f"{sh} references missing {ref}"
+            if ref.endswith(".py"):
+                py_compile.compile(full, doraise=True)
+            else:
+                subprocess.run(["bash", "-n", full], check=True)
+
+
+def test_bench_probe_flag_exists():
+    with open(os.path.join(REPO, "bench.py"), encoding="utf-8") as f:
+        src = f.read()
+    assert '"--probe"' in src  # the watch loop's probe contract
