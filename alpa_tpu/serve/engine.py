@@ -28,6 +28,37 @@ logger = logging.getLogger(__name__)
 _STREAM_END = object()
 
 
+class _TokenStream:
+    """Token iterator for ``submit_stream`` with a close() that works at
+    ANY point — including before the first token.  A plain generator
+    cannot do this: ``close()`` on a never-started generator is a no-op
+    (GeneratorExit only reaches a body suspended at a yield), so
+    pre-admission cancellation through a generator is unreachable."""
+
+    def __init__(self, item, q):
+        self._item = item
+        self._q = q
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t = self._q.get()
+        if t is _STREAM_END:
+            if self._item["error"] is not None:
+                raise self._item["error"]
+            raise StopIteration
+        return int(t)
+
+    def close(self):
+        """Flag the request cancelled: a queued request is retired at
+        admission, an active row is freed next tick."""
+        self._item["cancelled"] = True
+
+    def __del__(self):
+        self.close()
+
+
 class _DoneEvent(threading.Event):
     """Event with a completion hook (streams push their end sentinel from
     whichever engine path finishes the item — success, EOS, or error)."""
@@ -189,24 +220,10 @@ class ContinuousBatchingEngine:
         with self._cv:
             self._queue.append(item)
             self._cv.notify()
-
-        def _tokens():
-            try:
-                while True:
-                    t = q.get()
-                    if t is _STREAM_END:
-                        break
-                    yield int(t)
-            except GeneratorExit:
-                # consumer abandoned the stream (client disconnect):
-                # flag the row so the engine frees it next tick instead
-                # of decoding to max_new_tokens for nobody
-                item["cancelled"] = True
-                raise
-            if item["error"] is not None:
-                raise item["error"]
-
-        return _tokens()
+        # consumer abandoning the stream (client disconnect) calls
+        # close(), which cancels BEFORE admission too — a queued
+        # abandoned request is retired instead of burning a KV row
+        return _TokenStream(item, q)
 
     def _make_item(self, prompt, cfg, on_token, on_done=None, queue=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -254,11 +271,22 @@ class ContinuousBatchingEngine:
         requests being admitted — the engine loop and resident rows
         survive (a dead loop thread would deadlock every submitter).
         """
+        def next_live():
+            """Policy-head item, retiring requests cancelled while still
+            queued (client disconnected before admission: prefilling and
+            decoding them would burn a row for nobody).  Returns the
+            head WITHOUT popping it."""
+            while True:
+                nxt = self._queue.peek()
+                if nxt is None or not nxt.get("cancelled"):
+                    return nxt
+                self._queue.popleft()["done"].set()
+
         if self._packed is not None and len(self._queue) >= 2:
             free = [r for r in range(self.B) if not self._active[r]]
             take, total = [], 0
             while len(take) < len(free):
-                nxt = self._queue.peek()
+                nxt = next_live()
                 if nxt is None or total + len(nxt["prompt"]) > \
                         self._packed.total_bucket:
                     break
@@ -296,7 +324,7 @@ class ContinuousBatchingEngine:
                 # not enough for a pack: put back and fall through
                 self._queue.pushback(take)
         for r in range(self.B):
-            if self._active[r] or len(self._queue) == 0:
+            if self._active[r] or next_live() is None:
                 continue
             item = self._queue.popleft()
             try:

@@ -1,0 +1,84 @@
+"""Requests cancelled while still QUEUED (client gone before admission)
+are retired at admission time instead of burning a KV row decoding for
+nobody.  submit_stream returns an iterator OBJECT because a plain
+generator's close() is a no-op before the first next() — GeneratorExit
+never reaches an unstarted body, which made pre-admission cancellation
+unreachable (round-5 review catch, verified empirically)."""
+import threading
+import time
+
+import numpy as np
+
+from alpa_tpu.model.gpt_model import GPTConfig, init_gpt_real
+from alpa_tpu.serve.engine import ContinuousBatchingEngine
+from alpa_tpu.serve.generation import GenerationConfig, Generator
+
+CFG = GPTConfig(hidden_size=32, num_layers=2, num_heads=4, seq_len=64,
+                vocab_size=64)
+
+
+def test_queued_cancelled_request_never_admitted():
+    model, params = init_gpt_real(CFG, 1)
+    gen = Generator(model, params, CFG, batch_size=1, prompt_buckets=[8])
+    eng = ContinuousBatchingEngine(gen, max_batch=1, prompt_bucket=8)
+    try:
+        long_done = []
+
+        def long_req():
+            out = eng.submit(np.array([1, 2], np.int32),
+                             GenerationConfig(max_new_tokens=40))
+            long_done.append(out)
+
+        t = threading.Thread(target=long_req)
+        t.start()
+        # wait until the long request occupies the single row
+        for _ in range(400):
+            if eng.admissions >= 1:
+                break
+            time.sleep(0.05)
+        assert eng.admissions == 1
+
+        # queue a second request, then abandon its stream BEFORE it was
+        # ever admitted (never call next())
+        it = eng.submit_stream(np.array([3, 4], np.int32),
+                               GenerationConfig(max_new_tokens=40))
+        it.close()
+        assert it._item["cancelled"] is True  # close() reaches the item
+
+        # the engine retires the cancelled item at its next admission
+        # pass (while the long request still holds the only row)
+        for _ in range(400):
+            if len(eng._queue) == 0 and it._item["done"].is_set():
+                break
+            time.sleep(0.05)
+        assert it._item["done"].is_set()
+        assert len(eng._queue) == 0
+
+        t.join(timeout=180)
+        assert long_done and len(long_done[0]) == 42
+        # settle, then confirm the cancelled request never took a row
+        time.sleep(0.5)
+        assert eng.admissions == 1, "cancelled request was admitted"
+    finally:
+        eng.shutdown()
+
+
+def test_mid_stream_close_still_frees_the_row():
+    """Post-admission close keeps its old semantics: the row frees on
+    the next tick instead of decoding to max_new_tokens."""
+    model, params = init_gpt_real(CFG, 1)
+    gen = Generator(model, params, CFG, batch_size=1, prompt_buckets=[8])
+    eng = ContinuousBatchingEngine(gen, max_batch=1, prompt_bucket=8)
+    try:
+        it = eng.submit_stream(np.array([5, 6], np.int32),
+                               GenerationConfig(max_new_tokens=60))
+        first = next(it)
+        assert isinstance(first, int)
+        it.close()
+        for _ in range(400):
+            if not eng._active.any():
+                break
+            time.sleep(0.05)
+        assert not eng._active.any(), "row not freed after close()"
+    finally:
+        eng.shutdown()
