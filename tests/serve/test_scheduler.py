@@ -335,3 +335,60 @@ class TestTakeExceptionSafety:
             s.take(bad)
         assert len(s) == 6
         assert sorted(it["i"] for it in s.drain()) == list(range(6))
+
+
+class TestProtocolFuzz:
+    """Conservation fuzz: under random interleavings of every protocol
+    operation, no item is ever lost or duplicated, len() stays
+    consistent, and drain() always empties."""
+
+    @pytest.mark.parametrize("factory,seed", [
+        (FIFOQueue, 0),
+        (lambda: WeightedFairQueue({"a": 3.0, "b": 1.0}), 1),
+        (lambda: NestedScheduler(outer=WeightedFairQueue({"a": 2.0})), 2),
+    ])
+    def test_conservation_under_random_ops(self, factory, seed):
+        rng = np.random.RandomState(seed)
+        s = factory()
+        inside = {}          # id -> item currently owned by the queue
+        outside = []         # items popped/taken, eligible for pushback
+        next_id = [0]
+
+        def new_item():
+            q = ["a/x", "a/y", "b/z"][rng.randint(3)]
+            item = {"queue": q, "id": next_id[0]}
+            next_id[0] += 1
+            return item
+
+        for _ in range(3000):
+            op = rng.randint(6)
+            if op <= 1:                                   # append
+                it = new_item()
+                s.append(it)
+                inside[it["id"]] = it
+            elif op == 2 and len(s):                      # popleft
+                it = s.popleft()
+                del inside[it["id"]]
+                outside.append(it)
+            elif op == 3 and len(s):                      # take(random)
+                def sel(item, r=rng):
+                    return ("take", "skip", "stop")[r.randint(3)]
+                got = s.take(sel)
+                for it in got:
+                    del inside[it["id"]]
+                    outside.append(it)
+            elif op == 4 and outside:                     # pushback some
+                k = rng.randint(1, min(4, len(outside)) + 1)
+                back, outside[:] = outside[:k], outside[k:]
+                s.pushback(back)
+                for it in back:
+                    inside[it["id"]] = it
+            elif op == 5 and rng.random() < 0.05:         # rare drain
+                for it in s.drain():
+                    del inside[it["id"]]
+                    outside.append(it)
+            assert len(s) == len(inside), (len(s), len(inside))
+
+        drained = s.drain()
+        assert sorted(it["id"] for it in drained) == sorted(inside)
+        assert len(s) == 0
