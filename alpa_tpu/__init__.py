@@ -6,14 +6,18 @@ of stock jax/XLA — GSPMD over ICI for intra-mesh collectives, jax-runtime
 DCN transfers for cross-mesh resharding, no forked jaxlib, no Ray.
 See SURVEY.md for the design blueprint.
 """
-from alpa_tpu.api import (init, shutdown, parallelize, grad, value_and_grad)
+from alpa_tpu.api import (clear_executable_cache, init, shutdown,
+                          parallelize, grad, value_and_grad)
 from alpa_tpu.device_mesh import (DeviceCluster, DistributedArray,
+                                  DistributedPhysicalDeviceMesh,
                                   LocalPhysicalDeviceMesh, LogicalDeviceMesh,
                                   PhysicalDeviceMesh, PhysicalDeviceMeshGroup,
                                   VirtualPhysicalMesh,
                                   get_global_cluster,
+                                  get_global_num_devices,
                                   get_global_physical_mesh,
                                   get_global_virtual_physical_mesh,
+                                  prefetch,
                                   set_global_physical_mesh,
                                   set_global_virtual_physical_mesh, set_seed)
 from alpa_tpu.global_env import global_config
@@ -27,8 +31,11 @@ from alpa_tpu.data_loader import (DataLoader, DistributedDataLoader,
 from alpa_tpu.follow_parallel import FollowParallel
 from alpa_tpu.parallel_plan import (ParallelPlan, executable_to_plan,
                                     plan_to_method)
+from alpa_tpu.mesh_profiling import ProfilingResultDatabase
 from alpa_tpu.pipeline_parallel.layer_construction import (AutoLayerOption,
-                                                           ManualLayerOption)
+                                                           ManualLayerOption,
+                                                           automatic_remat,
+                                                           manual_remat)
 from alpa_tpu.pipeline_parallel.primitive_def import (mark_pipeline_boundary)
 from alpa_tpu.pipeline_parallel.stage_construction import (AutoStageOption,
                                                            ManualStageOption,

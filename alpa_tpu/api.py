@@ -9,6 +9,7 @@ splitting and batch-dim sharding — and dispatches compilation to a
 """
 import functools
 import logging
+import weakref
 from typing import Any, Callable, Optional, Sequence, Union
 
 import jax
@@ -82,6 +83,20 @@ def _abstractify(x):
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
 
+# live ParallelizedFunc registry for clear_executable_cache (ref
+# api.py clear_executable_cache); weak so decorated functions are
+# collectable
+_live_parallelized: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def clear_executable_cache():
+    """Drop every compiled executable cached by @parallelize functions
+    (ref alpa.clear_executable_cache): the next call recompiles."""
+    for pf in list(_live_parallelized):
+        pf._executable_cache.clear()
+        pf._last_executable = None
+
+
 class ParallelizedFunc:
     """The callable returned by ``@parallelize`` (ref api.py:106)."""
 
@@ -99,6 +114,7 @@ class ParallelizedFunc:
         self.batch_argnums = tuple(batch_argnums)
         self._executable_cache = {}
         self._last_executable = None
+        _live_parallelized.add(self)
 
     # ---- compilation ----
     def _decode_args(self, args):

@@ -38,6 +38,32 @@ logger = logging.getLogger(__name__)
 DistributedArray = jax.Array
 
 
+def prefetch(tree):
+    """Start async device->host copies for every array in ``tree`` (ref
+    ``alpa.prefetch``, device_mesh.py: fetches DistributedArray data
+    ahead of use).  Under the single-controller design this is
+    ``copy_to_host_async`` on each jax.Array leaf."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                leaf.copy_to_host_async()
+            except Exception:  # pylint: disable=broad-except
+                pass  # already-deleted/committed-host arrays
+
+
+def get_global_num_devices() -> int:
+    """Device count of the active cluster (ref
+    ``alpa.get_global_num_devices``); falls back to jax.device_count()
+    before init()."""
+    cluster = get_global_cluster()
+    if cluster is not None:
+        return cluster.num_devices
+    # honor the configured backend exactly as DeviceCluster would, so
+    # the count cannot change across init()
+    return jax.device_count(global_config.backend) \
+        if global_config.backend else jax.device_count()
+
+
 ########################################
 # Logical mesh + collective cost model
 ########################################
@@ -288,6 +314,14 @@ class LocalPhysicalDeviceMesh(PhysicalDeviceMesh):
         if devices is None:
             devices = jax.local_devices()
         super().__init__(np.array(list(devices)).reshape(1, -1))
+
+
+# The reference's DistributedPhysicalDeviceMesh is the Ray-actor-backed
+# multi-host mesh; under the single-controller jax runtime a multi-host
+# mesh is just PhysicalDeviceMesh over the global device grid (bring-up
+# via jax.distributed — see distributed.py), so the name is an alias
+# for API compatibility.
+DistributedPhysicalDeviceMesh = PhysicalDeviceMesh
 
 
 ########################################

@@ -326,6 +326,32 @@ def add_pipeline_marks_for_sliced_eqns(closed_jaxpr: ClosedJaxpr,
 ########################################
 
 
+def manual_remat(fun: Optional[Callable] = None):
+    """Rematerialize each manually-marked layer of ``fun`` (boundaries
+    from ``mark_pipeline_boundary()``), outside any pipeline compile —
+    ref ``manual_remat`` (layer_construction.py:542).  Usable as a bare
+    decorator or called with the function."""
+
+    def decorate(f):
+        return layer_level_transform(f, ManualLayerOption(remat_layer=True))
+
+    return decorate if fun is None else decorate(fun)
+
+
+def automatic_remat(fun: Optional[Callable] = None, *,
+                    layer_num: int = 2, eps: float = 0.6):
+    """Rematerialize ``fun`` at automatically-clustered layer boundaries
+    (flops-balanced DP) — ref ``automatic_remat``
+    (layer_construction.py:571)."""
+
+    def decorate(f):
+        return layer_level_transform(
+            f, AutoLayerOption(layer_num=layer_num, eps=eps,
+                               remat_layer=True))
+
+    return decorate if fun is None else decorate(fun)
+
+
 def layer_level_transform(fn: Callable, layer_option: LayerOption) -> Callable:
     """Wrap a loss function so tracing it yields a fully layer-marked jaxpr
     (ref manual/automatic_layer_construction decorators)."""

@@ -111,6 +111,11 @@ def main():
     ap.add_argument("--dir", default="/tmp/loading_drill")
     ap.add_argument("--keep", action="store_true")
     ap.add_argument("--skip-pipeshard", action="store_true")
+    ap.add_argument("--commit-artifact", action="store_true",
+                    help="write the report into benchmark/results/ "
+                    "(the committed artifact); otherwise it lands "
+                    "under --dir so test runs never dirty the tree "
+                    "with host-dependent timings")
     args = ap.parse_args()
 
     from alpa_tpu.platform import pin_cpu_platform
@@ -223,10 +228,12 @@ def main():
     if not args.keep:
         shutil.rmtree(ckpt, ignore_errors=True)
 
-    out_path = os.path.join(REPO, "benchmark", "results",
-                            "loading_drill_10b.json")
-    if args.small:
-        out_path = out_path.replace(".json", "_small.json")
+    base = os.path.join(REPO, "benchmark", "results") \
+        if args.commit_artifact else args.dir
+    os.makedirs(base, exist_ok=True)
+    out_path = os.path.join(
+        base, "loading_drill_10b_small.json" if args.small
+        else "loading_drill_10b.json")
     with open(out_path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report), flush=True)
