@@ -6,6 +6,9 @@ of stock jax/XLA — GSPMD over ICI for intra-mesh collectives, jax-runtime
 DCN transfers for cross-mesh resharding, no forked jaxlib, no Ray.
 See SURVEY.md for the design blueprint.
 """
+from alpa_tpu import jax_compat
+jax_compat.install()
+
 from alpa_tpu.api import (clear_executable_cache, init, shutdown,
                           parallelize, grad, value_and_grad)
 from alpa_tpu.device_mesh import (DeviceCluster, DistributedArray,
@@ -40,6 +43,7 @@ from alpa_tpu.pipeline_parallel.primitive_def import (mark_pipeline_boundary)
 from alpa_tpu.pipeline_parallel.stage_construction import (AutoStageOption,
                                                            ManualStageOption,
                                                            UniformStageOption)
+from alpa_tpu import fault
 from alpa_tpu.serialization import (restore_checkpoint, save_checkpoint)
 from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
 from alpa_tpu.shard_parallel.manual_sharding import ManualShardingOption

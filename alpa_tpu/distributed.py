@@ -20,6 +20,8 @@ from typing import Optional, Sequence
 
 import jax
 
+from alpa_tpu import fault
+
 logger = logging.getLogger(__name__)
 
 _initialized = False
@@ -54,8 +56,26 @@ def initialize(coordinator_address: Optional[str] = None,
             os.environ["JAX_PROCESS_ID"])
     if local_device_ids is not None:
         kwargs["local_device_ids"] = list(local_device_ids)
-    try:
+    if jax.config.jax_platforms == "cpu" or \
+            os.environ.get("JAX_PLATFORMS") == "cpu":
+        # cross-process computations on the CPU backend need the gloo
+        # collectives client; without it XLA rejects multi-node programs
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except AttributeError:
+            pass  # older jax: single collectives impl, nothing to select
+
+    def connect():
+        fault.fire("distributed_init", kwargs=sorted(kwargs))
         jax.distributed.initialize(**kwargs)
+
+    try:
+        # the coordinator may come up later than the workers: retry the
+        # connection with backoff (site "distributed_init", no-retry by
+        # default) before concluding we are single-process
+        fault.call_with_retry(connect, site="distributed_init",
+                              retry_on=(RuntimeError, ConnectionError,
+                                        fault.InjectedFault))
         _initialized = True
         logger.info("jax.distributed initialized: process %d/%d, %d local "
                     "of %d global devices", jax.process_index(),
@@ -155,8 +175,10 @@ def sum_across_processes(canvas: "np.ndarray") -> "np.ndarray":
             jnp.asarray(canvas[None] if d == first_local else zeros), d)
         for d in jax.local_devices()
     ]
+    # no dtype kwarg: inferred from the (always non-empty) shards, and
+    # older jax does not accept it
     stacked = jax.make_array_from_single_device_arrays(
-        (len(devs),) + tuple(shape), slot_sh, shards, dtype=work)
+        (len(devs),) + tuple(shape), slot_sh, shards)
     summed = jax.jit(lambda a: a.sum(0),
                      out_shardings=NamedSharding(gmesh, P()))(stacked)
     return np.asarray(summed.addressable_shards[0].data)

@@ -1,0 +1,58 @@
+"""Additive shims for jax API renames (installed from ``alpa_tpu/__init__``).
+
+The codebase targets the modern spellings (``jax.set_mesh``,
+``jax.shard_map``); older jax (0.4.x) ships the same functionality under
+different names.  Each shim is installed only when the modern name is
+absent, so on current jax this module is a no-op.
+"""
+import jax
+
+
+def _set_mesh_compat(mesh):
+    # Mesh is itself a context manager on older jax, so returning it makes
+    # ``with jax.set_mesh(mesh):`` equivalent to ``with mesh:``
+    return mesh
+
+
+def _shard_map_compat(f, *, mesh, in_specs, out_specs, axis_names=None,
+                      check_vma=True):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # modern axis_names lists the MANUAL axes; the old API takes the
+    # complement (``auto`` = axes left automatic inside the body)
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    if auto:
+        # partial-automatic shard_map on old jax miscompiles (XLA
+        # PartitionId errors, hard aborts on CPU) — refuse up front so
+        # callers get a diagnosable error instead of a process abort
+        raise NotImplementedError(
+            f"partial-automatic shard_map (auto axes {sorted(auto)}) "
+            "requires a newer jax than this environment provides")
+    return _shard_map(f, mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma, auto=auto)
+
+
+def _get_abstract_mesh_compat():
+    # the ambient mesh on older jax is whatever ``with mesh:`` entered
+    # (which is what _set_mesh_compat resolves to)
+    from jax._src import mesh as mesh_lib
+    return mesh_lib.thread_resources.env.physical_mesh
+
+
+def _axis_size_compat(axis_name):
+    # psum of a python scalar is computed statically: the classic
+    # pre-jax.lax.axis_size spelling of "size of this mapped axis"
+    return jax.lax.psum(1, axis_name)
+
+
+def install():
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = _set_mesh_compat
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh_compat
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size_compat

@@ -128,6 +128,14 @@ class NormalMeshExecutable(MeshExecutable):
             if (isinstance(a, jax.Array) and a.committed and
                     a.sharding.is_equivalent_to(s, a.ndim)):
                 out.append(a)
+            elif not s.is_fully_addressable:
+                # multi-process mesh: device_put rejects shardings with
+                # non-addressable devices — build the global array from
+                # this process's local shards instead (every process holds
+                # the full host value here)
+                arr = np.asarray(a)
+                out.append(jax.make_array_from_callback(
+                    arr.shape, s, lambda idx, _arr=arr: _arr[idx]))
             else:
                 out.append(jax.device_put(a, s))
         return out

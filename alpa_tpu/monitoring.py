@@ -1,4 +1,4 @@
-"""Health checking, failure detection, and debug dumps.
+"""Health checking, failure detection, recovery, and debug dumps.
 
 Analog of ref SURVEY.md §5 failure detection: ``check_alive`` no-op RPC
 (ref device_mesh.py:616) + ``PipeshardDriverExecutable._check_alive``
@@ -7,6 +7,13 @@ Analog of ref SURVEY.md §5 failure detection: ``check_alive`` no-op RPC
 liveness = a tiny device program completing within a timeout per mesh;
 debug dumps collect every IR the compiler produced
 (ref dump_debug_info, pipeshard_executable.py:357).
+
+Beyond the reference's passive detection, ``FailureWatchdog`` drives the
+``fault.RecoveryManager`` state machine (HEALTHY -> SUSPECT ->
+RECOVERING -> DEGRADED): on mesh failure it quiesces in-flight pipeshard
+work, snapshots driver-side state, and either re-probes back to HEALTHY
+or fails the serving stack over to load-shedding degraded mode.  See
+docs/fault_tolerance.md.
 """
 import concurrent.futures
 import logging
@@ -17,14 +24,23 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 
+from alpa_tpu import fault
+
 logger = logging.getLogger(__name__)
 
 
-def check_alive(mesh, timeout: float = 10.0) -> bool:
+def check_alive(mesh, timeout: float = 10.0,
+                retry_policy: Optional["fault.RetryPolicy"] = None) -> bool:
     """True iff every device of the mesh completes a trivial program within
-    ``timeout`` seconds (ref check_alive no-op RPC)."""
+    ``timeout`` seconds (ref check_alive no-op RPC).
+
+    ``retry_policy`` (default: the installed policy for site ``probe``,
+    no-retry out of the box) re-probes with jittered backoff before
+    declaring the mesh dead — one slow tick must not trip recovery.
+    """
 
     def probe():
+        fault.fire("probe", mesh=mesh)
         vals = [
             jax.device_put(jnp.zeros(()), d) + 1
             for d in mesh.flat_devices
@@ -32,13 +48,23 @@ def check_alive(mesh, timeout: float = 10.0) -> bool:
         jax.block_until_ready(vals)
         return True
 
-    # No context manager: with a genuinely hung device the probe thread
-    # never finishes, and pool.__exit__ would join it forever — exactly the
-    # case this function must detect.  The daemon thread is abandoned.
-    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-    fut = pool.submit(probe)
+    def probe_once():
+        # No context manager: with a genuinely hung device the probe
+        # thread never finishes, and pool.__exit__ would join it forever
+        # — exactly the case this function must detect.  The daemon
+        # thread is abandoned.
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+        fut = pool.submit(probe)
+        try:
+            return bool(fut.result(timeout=timeout))
+        finally:
+            pool.shutdown(wait=False)
+
+    policy = retry_policy or fault.get_retry_policy("probe")
     try:
-        return bool(fut.result(timeout=timeout))
+        return bool(fault.call_with_retry(
+            probe_once, policy=policy, site="probe",
+            retry_on=(concurrent.futures.TimeoutError, Exception)))
     except concurrent.futures.TimeoutError:
         logger.error("mesh %s failed liveness probe (%.1fs timeout)",
                      mesh, timeout)
@@ -46,8 +72,6 @@ def check_alive(mesh, timeout: float = 10.0) -> bool:
     except Exception as e:  # pylint: disable=broad-except
         logger.error("mesh %s liveness probe raised: %s", mesh, e)
         return False
-    finally:
-        pool.shutdown(wait=False)
 
 
 def check_mesh_group_alive(mesh_group, timeout: float = 10.0) -> List[bool]:
@@ -55,17 +79,38 @@ def check_mesh_group_alive(mesh_group, timeout: float = 10.0) -> List[bool]:
 
 
 class FailureWatchdog:
-    """Periodic liveness checking with a callback
-    (the elastic-recovery hook the reference lacks, SURVEY.md §5)."""
+    """Periodic liveness checking driving the recovery state machine
+    (the elastic-recovery hook the reference lacks, SURVEY.md §5).
+
+    Backward-compatible surface: ``on_failure(dead_indices)`` still
+    fires on every failed probe round.  New surface: pass ``recovery=``
+    a :class:`alpa_tpu.fault.RecoveryManager` (or let the watchdog build
+    a plain one) and each round's verdict drives HEALTHY -> SUSPECT ->
+    RECOVERING -> DEGRADED with quiesce/snapshot/degrade hooks; the
+    current state is readable via ``watchdog.state``.
+    """
 
     def __init__(self, mesh_group, interval: float = 60.0,
-                 on_failure=None):
+                 on_failure=None, recovery: Optional[
+                     "fault.RecoveryManager"] = None,
+                 probe_timeout: float = 10.0):
         import threading
         self.mesh_group = mesh_group
         self.interval = interval
         self.on_failure = on_failure or (lambda dead: None)
+        self.probe_timeout = probe_timeout
+        if recovery is None:
+            recovery = fault.RecoveryManager(mesh_group,
+                                             probe_timeout=probe_timeout)
+        elif recovery.mesh_group is None:
+            recovery.mesh_group = mesh_group
+        self.recovery = recovery
         self._stop = threading.Event()
         self._thread = None
+
+    @property
+    def state(self) -> "fault.MeshHealth":
+        return self.recovery.state
 
     def start(self):
         import threading
@@ -74,12 +119,20 @@ class FailureWatchdog:
 
     def _loop(self):
         while not self._stop.is_set():
-            alive = check_mesh_group_alive(self.mesh_group)
+            alive = check_mesh_group_alive(self.mesh_group,
+                                           self.probe_timeout)
             if self._stop.is_set():
                 return  # stopped during the probe: don't fire callbacks
             dead = [i for i, a in enumerate(alive) if not a]
             if dead:
-                self.on_failure(dead)
+                try:
+                    self.on_failure(dead)
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception("on_failure callback failed")
+            try:
+                self.recovery.observe(dead)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception("recovery state machine raised")
             self._stop.wait(self.interval)
 
     def stop(self):

@@ -25,6 +25,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from alpa_tpu import fault
+
 logger = logging.getLogger(__name__)
 
 
@@ -301,6 +303,8 @@ class ReshardingTask:
     def run(self, src_array, mode: Optional[str] = None):
         import jax
         mode = mode or self.mode
+        fault.fire("cross_mesh_recv", mode=mode,
+                   n_requests=len(self.spec.requests))
         if mode == "device_put" or not self.spec.requests:
             self.last_report = ExecutionReport(mode="device_put")
             return jax.device_put(src_array, self.dst_sharding)
@@ -351,6 +355,10 @@ class ReshardingTask:
         from alpa_tpu.distributed import (psum_work_dtype, put_global,
                                           sum_across_processes)
 
+        # fires BEFORE the collective: every process injects (or not)
+        # identically, so the lock-step instruction streams stay aligned
+        fault.fire("cross_mesh_recv", mode="multiprocess",
+                   n_requests=len(self.spec.requests))
         spec = self.spec
         if not spec.requests:
             self.last_report = ExecutionReport(mode="device_put")
@@ -413,8 +421,10 @@ class ReshardingTask:
                 buf[dst_idx] = tile_arr[src_idx]
             arrs.append(jax.device_put(jnp.asarray(buf.astype(dtype)),
                                        dev))
+        # no dtype kwarg: older jax rejects it; every arr already carries
+        # the work dtype
         out = jax.make_array_from_single_device_arrays(
-            spec.shape, self.dst_sharding, arrs, dtype=dtype)
+            spec.shape, self.dst_sharding, arrs)
         self.last_report = report
         return out
 

@@ -22,6 +22,7 @@ import numpy as np
 from jax._src.core import jaxpr_as_fun
 from jax.extend.core import Literal, Var
 
+from alpa_tpu import fault
 from alpa_tpu.global_env import global_config
 from alpa_tpu.mesh_executable import alloc_zero_buffers
 from alpa_tpu.pipeline_parallel.runtime_emitter import (
@@ -547,17 +548,53 @@ class PipeshardDriverExecutable:
         self._acct_lock = threading.Lock()
         self._const_cache = None
         self._zero_exec_cache = None
+        # quiesce gate: fault.RecoveryManager pauses new launches and
+        # waits out in-flight ones before snapshotting driver state
+        self._launch_gate = threading.Event()
+        self._launch_gate.set()
+        self._inflight_launches = 0
+        self._quiesce_cv = threading.Condition()
 
     # ------------------------------------------------------------------
     # execution
     # ------------------------------------------------------------------
     def launch_on_driver(self, *flat_args):
+        # blocks while quiesced (recovery in progress): a launch racing
+        # a mesh failure would dispatch onto dead devices
+        self._launch_gate.wait()
+        with self._quiesce_cv:
+            self._inflight_launches += 1
         timer = timers("pipeshard-dispatch")
         timer.start()
         try:
             return self._launch(*flat_args)
         finally:
             timer.stop()
+            with self._quiesce_cv:
+                self._inflight_launches -= 1
+                self._quiesce_cv.notify_all()
+
+    def quiesce(self, timeout: Optional[float] = None) -> bool:
+        """Pause new launches and wait until in-flight pipeshard work
+        drains (the recovery state machine's pre-snapshot step).
+        Returns True when the driver reached a quiescent point within
+        ``timeout``; launches stay blocked until :meth:`resume`."""
+        self._launch_gate.clear()
+        with self._quiesce_cv:
+            drained = self._quiesce_cv.wait_for(
+                lambda: self._inflight_launches == 0, timeout)
+        if drained:
+            try:
+                self.sync()  # drain on-device queues too
+            except Exception:  # pylint: disable=broad-except
+                # a dead mesh cannot sync — quiescing must still succeed
+                # driver-side so recovery can proceed
+                logger.exception("quiesce: device sync failed")
+        return bool(drained)
+
+    def resume(self):
+        """Re-open the launch gate after recovery."""
+        self._launch_gate.set()
 
     def _launch(self, *flat_args):
         env: Dict[Tuple[Var, int], Dict[int, Any]] = {}
@@ -741,45 +778,70 @@ class PipeshardDriverExecutable:
                         "emit-model sharding miss: %s arg[%d] %s -> %s",
                         inst.info, i, a.sharding.spec, s.spec)
                     args[i] = _put(a, s)
-            outs = exec_.compiled(*args)
+            if fault.instrumented():
+                # Donated-buffer stages are NOT idempotent (a re-run
+                # would read freed inputs): only injected faults — which
+                # fire before the real execution — are retried there.
+                outs = fault.call_with_retry(
+                    lambda: (fault.fire("stage_launch", stage=inst.info,
+                                        mesh_id=inst.dst_mesh),
+                             exec_.compiled(*args))[1],
+                    site="stage_launch",
+                    idempotent=not exec_.donate_idx)
+            else:
+                outs = exec_.compiled(*args)
             for k, o in zip(inst.output_keys, outs):
                 env.setdefault(k, {})[inst.dst_mesh] = o
             if collect:
                 tracer.log("RUN", inst.info)
         elif inst.opcode == PipelineInstType.RESHARD:
             val = env[inst.var_key][inst.src_mesh]
-            if (mp_planned and inst.src_mesh != inst.dst_mesh and
-                    inst.plan is not None):
-                if inst.task is None:
-                    from alpa_tpu.pipeline_parallel. \
-                        cross_mesh_resharding import ReshardingTask
-                    inst.task = ReshardingTask(inst.plan, inst.dst_sharding)
-                env[inst.var_key][inst.dst_mesh] = \
-                    inst.task.run_multiprocess(val)
+
+            def transfer():
+                fault.fire("cross_mesh_send", var=str(inst.var_key[0]),
+                           src_mesh=inst.src_mesh, dst_mesh=inst.dst_mesh)
+                if (mp_planned and inst.src_mesh != inst.dst_mesh and
+                        inst.plan is not None):
+                    if inst.task is None:
+                        from alpa_tpu.pipeline_parallel. \
+                            cross_mesh_resharding import ReshardingTask
+                        inst.task = ReshardingTask(inst.plan,
+                                                   inst.dst_sharding)
+                    env[inst.var_key][inst.dst_mesh] = \
+                        inst.task.run_multiprocess(val)
+                elif (exec_mode == "planned" and
+                      inst.src_mesh != inst.dst_mesh and
+                      inst.plan is not None):
+                    # Drive the tile plan literally (per-tile routed
+                    # transfers; send_recv or broadcast leg choice from
+                    # global_config.resharding_mode, ref :418/:935).
+                    if inst.task is None:
+                        from alpa_tpu.pipeline_parallel. \
+                            cross_mesh_resharding import ReshardingTask
+                        inst.task = ReshardingTask(inst.plan,
+                                                   inst.dst_sharding)
+                    mode = ("broadcast" if global_config.resharding_mode ==
+                            "broadcast" else "tiled")
+                    env[inst.var_key][inst.dst_mesh] = inst.task.run(
+                        val, mode)
+                else:
+                    env[inst.var_key][inst.dst_mesh] = _put(
+                        val, inst.dst_sharding)
+                    return
                 rep = inst.task.last_report
                 with self._acct_lock:
                     self._executed_resharding_bytes += rep.cross_mesh_bytes
                     self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
-            elif (exec_mode == "planned" and
-                  inst.src_mesh != inst.dst_mesh and
-                  inst.plan is not None):
-                # Drive the tile plan literally (per-tile routed
-                # transfers; send_recv or broadcast leg choice from
-                # global_config.resharding_mode, ref :418/:935).
-                if inst.task is None:
-                    from alpa_tpu.pipeline_parallel. \
-                        cross_mesh_resharding import ReshardingTask
-                    inst.task = ReshardingTask(inst.plan, inst.dst_sharding)
-                mode = ("broadcast" if global_config.resharding_mode ==
-                        "broadcast" else "tiled")
-                env[inst.var_key][inst.dst_mesh] = inst.task.run(val, mode)
-                rep = inst.task.last_report
-                with self._acct_lock:
-                    self._executed_resharding_bytes += rep.cross_mesh_bytes
-                    self._executed_intra_mesh_bytes += rep.intra_mesh_bytes
+
+            if fault.instrumented():
+                # a transfer reads the source value functionally:
+                # re-running after a failure is safe single-process; the
+                # multiprocess collective path must stay lock-step, so
+                # it only gets detection (no blind re-runs)
+                fault.call_with_retry(transfer, site="cross_mesh_send",
+                                      idempotent=not mp_planned)
             else:
-                env[inst.var_key][inst.dst_mesh] = _put(
-                    val, inst.dst_sharding)
+                transfer()
             if collect:
                 tracer.log("RESHARD", inst.info)
         else:  # FREE

@@ -10,24 +10,44 @@ knows how to force it (used by ``tests/conftest.py`` and
 import os
 
 
-def pin_cpu_platform(n_devices: int) -> None:
-    """Pin this process to an ``n_devices``-device virtual CPU backend.
-
-    Must run before the first jax backend use.  Mutates process-global
-    jax config: any later work in the same process sees the CPU
-    backend — run TPU work in a separate process.
-    """
+def set_cpu_device_count(n_devices: int) -> None:
+    """Request ``n_devices`` virtual CPU devices WITHOUT touching the
+    backend (multi-process workers must still run
+    ``jax.distributed.initialize`` afterwards, which a backend probe
+    would break).  Must run before the first jax backend use."""
     import jax
 
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        # Older jax has no jax_num_cpu_devices config option; the XLA
+        # flag is the portable spelling and is read at first backend
+        # initialization, which hasn't happened yet on this path.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{n_devices}").strip()
     except RuntimeError as e:
         raise RuntimeError(
             "CPU pin ineffective — a jax backend was already initialized "
             "in this process; call pin_cpu_platform() before any jax "
             "operation, or run in a fresh process") from e
+
+
+def pin_cpu_platform(n_devices: int) -> None:
+    """Pin this process to an ``n_devices``-device virtual CPU backend.
+
+    Must run before the first jax backend use.  Mutates process-global
+    jax config and initializes the backend to verify the pin took: any
+    later work in the same process sees the CPU backend — run TPU work
+    in a separate process.
+    """
+    import jax
+
+    set_cpu_device_count(n_devices)
     devices = jax.devices()
     assert devices[0].platform == "cpu" and len(devices) == n_devices, (
         f"expected {n_devices} cpu devices, got {devices}")

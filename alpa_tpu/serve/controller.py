@@ -13,7 +13,8 @@ Endpoints:
   POST /completions                     -> {"model", "prompt_ids",
         "max_new_tokens"?, "temperature"?, "top_k"?, "do_sample"?}
         => {"output_ids": [[...]]}
-  GET  /health                          -> liveness
+  GET  /health                          -> {"status": "ok" | "degraded"
+        | "shedding"} (503 when shedding; see docs/fault_tolerance.md)
 """
 import dataclasses
 import json
@@ -24,6 +25,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from alpa_tpu import fault
 from alpa_tpu.serve.generation import GenerationConfig, Generator
 
 logger = logging.getLogger(__name__)
@@ -59,9 +61,14 @@ class RequestBatcher:
                     f"{method}(); see serve.scheduler's queue protocol")
         self._queue = scheduler
         self._cv = threading.Condition()
+        self.batches_run = 0          # introspection for tests
+        # degraded mode: a broken custom scheduler demotes this batcher
+        # to a fresh FIFO queue instead of failing queued requests
+        self.degraded = False
+        self.degraded_reason: Optional[str] = None
+        self.on_degraded = None       # callback(exc), set by _Replica
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
-        self.batches_run = 0          # introspection for tests
 
     def submit(self, prompts: List[np.ndarray],
                cfg: GenerationConfig,
@@ -121,15 +128,38 @@ class RequestBatcher:
                     return "skip"
 
                 try:
+                    fault.fire("scheduler_take",
+                               backlog=len(self._queue))
                     batch = self._queue.take(selector)
                 except Exception as e:  # pylint: disable=broad-except
-                    # a faulty custom scheduler must fail REQUESTS, not
-                    # the worker thread (a dead thread hangs every
-                    # later submit() silently)
-                    logger.exception("scheduler.take failed")
-                    for item in self._queue.drain():
-                        item["error"] = e
-                        item["done"].set()
+                    # a faulty custom scheduler must not take queued
+                    # requests down with it: demote to a fresh FIFO,
+                    # carry every drained item over, and keep serving
+                    # (degraded — policy lost, liveness kept).  Failing
+                    # the whole backlog here would turn one policy bug
+                    # into N client-visible errors.
+                    logger.exception(
+                        "scheduler.take failed; degrading to FIFO")
+                    from alpa_tpu.serve.scheduler import FIFOQueue
+                    fresh = FIFOQueue()
+                    try:
+                        for item in self._queue.drain():
+                            fresh.append(item)
+                    except Exception:  # pylint: disable=broad-except
+                        # drain is the last resort; if even that raises,
+                        # whatever it yielded so far is preserved
+                        logger.exception("scheduler.drain also failed")
+                    self._queue = fresh
+                    if not self.degraded:
+                        self.degraded = True
+                        self.degraded_reason = \
+                            f"{type(e).__name__}: {e}"
+                        if self.on_degraded is not None:
+                            try:
+                                self.on_degraded(e)
+                            except Exception:  # pylint: disable=broad-except
+                                logger.exception(
+                                    "on_degraded callback failed")
                     continue
                 if not batch:
                     continue
@@ -162,15 +192,20 @@ class RequestBatcher:
 class _Replica:
 
     def __init__(self, generator: Generator, prefix=None,
-                 scheduler_factory=None):
+                 scheduler_factory=None, on_degraded=None):
         self.generator = generator
         self.batcher = RequestBatcher(
             generator, prefix=prefix,
             scheduler=scheduler_factory() if scheduler_factory else None)
+        self.batcher.on_degraded = on_degraded
         self.prefix = prefix
         self.scheduler_factory = scheduler_factory
         self._engine = None
         self._lock = threading.Lock()
+
+    @property
+    def degraded(self) -> bool:
+        return self.batcher.degraded
 
     @property
     def engine(self):
@@ -198,6 +233,53 @@ class Controller:
         self._rr: Dict[str, int] = {}
         self._prefix_ids: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # health: "ok" -> full service; "degraded" -> serving, but some
+        # replica lost its admission policy (FIFO fallback); "shedding"
+        # -> recovery declared the backend dead, new work is rejected
+        # with ServiceDegradedError (HTTP 503) until recovery clears it
+        self._health = "ok"
+        self._health_reason: Optional[str] = None
+
+    # -- health / graceful degradation --------------------------------
+
+    def set_health(self, state: str, reason: Optional[str] = None):
+        if state not in ("ok", "degraded", "shedding"):
+            raise ValueError(f"unknown health state {state!r}")
+        with self._lock:
+            self._health = state
+            self._health_reason = reason
+        logger.warning("controller health -> %s (%s)", state, reason)
+
+    def health_report(self) -> Dict[str, Any]:
+        with self._lock:
+            state, reason = self._health, self._health_reason
+            degraded = sorted(name for name, reps in self._models.items()
+                              if any(r.degraded for r in reps))
+        if state == "ok" and degraded:
+            state = "degraded"
+            reason = f"replica scheduler fallback: {degraded}"
+        report = {"status": state}
+        if reason:
+            report["reason"] = reason
+        if degraded:
+            report["degraded_models"] = degraded
+        return report
+
+    def attach_recovery(self, recovery) -> None:
+        """Bind a :class:`alpa_tpu.fault.RecoveryManager`: entering
+        DEGRADED sheds load here (503s), recovering restores service."""
+        recovery.on_degrade = (
+            lambda reason=None: self.set_health(
+                "shedding", reason or "mesh recovery failed"))
+        recovery.on_recover = (
+            lambda: self.set_health("ok", "recovered"))
+
+    def _check_shedding(self):
+        with self._lock:
+            state, reason = self._health, self._health_reason
+        if state == "shedding":
+            raise fault.ServiceDegradedError(
+                f"service unavailable: {reason or 'backend recovering'}")
 
     def register_model(self, name: str, generator: Generator,
                        prefix_ids=None, scheduler_factory=None):
@@ -246,7 +328,10 @@ class Controller:
                 self._prefix_ids[name] = prefix_ids
             self._models.setdefault(name, []).append(
                 _Replica(generator, prefix=prefix,
-                         scheduler_factory=scheduler_factory))
+                         scheduler_factory=scheduler_factory,
+                         on_degraded=lambda e, n=name: logger.warning(
+                             "model %s replica degraded to FIFO: %s",
+                             n, e)))
             self._rr.setdefault(name, 0)
         logger.info("registered model %s (%d replicas%s)", name,
                     len(self._models[name]),
@@ -264,7 +349,11 @@ class Controller:
 
     def _parse_request(self, request: Dict[str, Any]):
         """Shared request validation: (replica, prompt_ids, cfg) — one
-        parser so streaming and non-streaming cannot diverge."""
+        parser so streaming and non-streaming cannot diverge.  Checks
+        load shedding FIRST: in shedding mode every new request is
+        rejected up front (503) — cheap refusal beats queueing work the
+        backend cannot run."""
+        self._check_shedding()
         name = request["model"]
         if name not in self._models:
             raise KeyError(f"unknown model {name!r}; "
@@ -321,7 +410,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/health":
-            self._send(200, {"status": "ok"})
+            report = self.controller.health_report()
+            code = 503 if report["status"] == "shedding" else 200
+            self._send(code, report)
         elif self.path == "/models":
             self._send(200, {"models": self.controller.list_models()})
         else:
@@ -339,6 +430,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             result = self.controller.completions(request)
             self._send(200, result)
+        except fault.ServiceDegradedError as e:
+            self._send(503, {"error": str(e)})
         except KeyError as e:
             self._send(404, {"error": str(e)})
         except (json.JSONDecodeError, ValueError, AssertionError,

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from alpa_tpu import fault
 from alpa_tpu.model.gpt_model import init_kv_caches
 from alpa_tpu.serve.generation import (GenerationConfig, Generator,
                                        _sample_logits)
@@ -160,6 +161,7 @@ class ContinuousBatchingEngine:
         self._rng = jax.random.PRNGKey(0)
         self.admissions = 0
         self.decode_steps = 0
+        self.step_failures = 0
         self._stop = False
 
         def scatter_row(caches, caches1, logits, logits1, row):
@@ -381,6 +383,7 @@ class ContinuousBatchingEngine:
                 self._step()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception("engine step failed")
+                self.step_failures += 1
                 with self._cv:
                     for r in range(self.B):
                         if self._active[r]:
@@ -391,6 +394,8 @@ class ContinuousBatchingEngine:
 
     def _step(self):
         """One decode tick for every active row."""
+        fault.fire("scheduler_tick", step=self.decode_steps,
+                   active=int(self._active.sum()))
         self._rng, sub = jax.random.split(self._rng)
         # sampling settings come from each row's cfg; rows with identical
         # settings dominate in practice — sample with row 0's active cfg

@@ -49,6 +49,14 @@ class GenerationConfig:
     eos_token_id: Optional[int] = None
 
 
+# The ONE top-k mask value, shared by the device sampler and the
+# host-side prob warper.  It must be -inf: a finite sentinel like -1e9
+# leaves masked tokens with tiny-but-nonzero device probability while
+# the host assigns them exactly zero, and speculative sampling's
+# acceptance ratio p/q is only exact when both agree on the support.
+TOP_K_MASK = float("-inf")
+
+
 def _sample_logits(logits, rng, cfg: GenerationConfig):
     logits = logits.astype(jnp.float32)
     if not cfg.do_sample:
@@ -57,7 +65,7 @@ def _sample_logits(logits, rng, cfg: GenerationConfig):
         logits = logits / jnp.maximum(cfg.temperature, 1e-6)
     if cfg.top_k > 0:
         top = jax.lax.top_k(logits, cfg.top_k)[0][..., -1:]
-        logits = jnp.where(logits < top, -1e9, logits)
+        logits = jnp.where(logits < top, TOP_K_MASK, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
@@ -70,7 +78,7 @@ def _warp_probs_np(logits, cfg: GenerationConfig) -> np.ndarray:
         x = x / max(cfg.temperature, 1e-6)
     if cfg.top_k > 0:
         kth = np.partition(x, -cfg.top_k, axis=-1)[..., -cfg.top_k, None]
-        x = np.where(x < kth, -np.inf, x)
+        x = np.where(x < kth, TOP_K_MASK, x)
     x = x - x.max(axis=-1, keepdims=True)
     p = np.exp(x)
     return p / p.sum(axis=-1, keepdims=True)

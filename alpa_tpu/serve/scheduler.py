@@ -211,31 +211,34 @@ class WeightedFairQueue(_FrontedQueue):
         return self._heap[0][2] if self._heap else None
 
     def _take_policy(self, selector, taken: List):
-        kept = []
-        entries = sorted(self._heap)
+        # Lazy pops: peek the heap head, decide, then pop — entries are
+        # visited in tag order straight off the heap, so the common
+        # take (head batch, then "stop" once full) costs O(k log n)
+        # against an n-item backlog instead of the full O(n log n)
+        # sort-and-rebuild.  The unvisited tail is never touched.
+        skipped = []
         try:
-            for i, entry in enumerate(entries):
+            while self._heap:
+                entry = self._heap[0]
                 tag, _seq, item = entry
                 decision = selector(item)
                 if decision == "take":
+                    heapq.heappop(self._heap)
                     taken.append(item)
                     self._advance(tag)
                 elif decision == "skip":
-                    kept.append(entry)
+                    heapq.heappop(self._heap)
+                    skipped.append(entry)
                 else:
-                    # skipped entries keep their tags; unvisited tail
-                    kept.extend(entries[i:])
                     break
-        except Exception:
-            # the in-flight entry (selector raised) and the unvisited
-            # tail stay; entries taken so far are removed from the heap
-            # (take() pushes the taken ITEMS back to the front)
-            kept.extend(entries[i:])
-            self._heap = kept
-            heapq.heapify(self._heap)
-            raise
-        self._heap = kept
-        heapq.heapify(self._heap)
+        finally:
+            # also the raise path: the in-flight entry (peeked, never
+            # popped) and the unvisited tail stay put; skipped entries
+            # return with their original tags; entries taken so far are
+            # off the heap (take() pushes the taken ITEMS back to the
+            # front)
+            for entry in skipped:
+                heapq.heappush(self._heap, entry)
         return taken
 
     def _drain_policy(self) -> List:

@@ -567,6 +567,25 @@ def _convert_gpt2_block(mod, params_prefix: str):
     attn = mod.attn
     if getattr(attn, "is_cross_attention", False):
         raise NotImplementedError("GPT2Block cross-attention")
+    if getattr(mod, "training", False):
+        # leaf modules evade _find_active_dropout (the tracer never
+        # descends into them), and this mapping is deterministic — a
+        # train-mode block with live dropout would silently mistrain,
+        # the exact failure functionalize's explicit-policy refusal
+        # exists to prevent
+        sites = {"attn.attn_dropout": getattr(attn, "attn_dropout", None),
+                 "attn.resid_dropout": getattr(attn, "resid_dropout",
+                                               None),
+                 "mlp.dropout": getattr(mod.mlp, "dropout", None)}
+        active = sorted(name for name, drop in sites.items()
+                        if drop is not None and
+                        getattr(drop, "p", 0.0) > 0)
+        if active:
+            raise ValueError(
+                "GPT2Block leaf conversion: train-mode block has active "
+                f"dropout ({active}) which the deterministic leaf "
+                "mapping would silently drop — .eval() the block or "
+                "construct it with zero attn_pdrop/resid_pdrop")
     if getattr(attn, "scale_attn_by_inverse_layer_idx", False) or \
             getattr(attn, "reorder_and_upcast_attn", False):
         raise NotImplementedError(

@@ -115,12 +115,21 @@ def clone_jaxpr(closed_jaxpr: ClosedJaxpr,
                 consts=None) -> ClosedJaxpr:
     """Build a new ClosedJaxpr overriding selected fields."""
     jaxpr = closed_jaxpr.jaxpr
-    new_jaxpr = jaxpr.replace(
+    kwargs = dict(
         invars=list(invars) if invars is not None else jaxpr.invars,
         outvars=list(outvars) if outvars is not None else jaxpr.outvars,
         eqns=list(eqns) if eqns is not None else jaxpr.eqns,
         constvars=list(constvars) if constvars is not None else jaxpr.constvars,
     )
+    dbg = getattr(jaxpr, "debug_info", None)
+    if dbg is not None and (
+            len(getattr(dbg, "arg_names", ())) != len(kwargs["invars"]) or
+            len(getattr(dbg, "result_paths", ())) !=
+            len(kwargs["outvars"])):
+        # the traced-for debug names no longer line up with the cloned
+        # signature; newer jax asserts on the mismatch at construction
+        kwargs["debug_info"] = None
+    new_jaxpr = jaxpr.replace(**kwargs)
     new_consts = list(consts) if consts is not None else closed_jaxpr.consts
     return ClosedJaxpr(new_jaxpr, new_consts)
 

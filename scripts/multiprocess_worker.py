@@ -126,9 +126,9 @@ def main():
     port = sys.argv[3]
     mode = sys.argv[4] if len(sys.argv) > 4 else "basic"
 
+    from alpa_tpu.platform import set_cpu_device_count
+    set_cpu_device_count(2 if mode == "auto" else 4)
     import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2 if mode == "auto" else 4)
     import alpa_tpu.distributed as dist
     dist.initialize(coordinator_address=f"127.0.0.1:{port}",
                     num_processes=nproc, process_id=process_id)

@@ -40,6 +40,7 @@ class TestGPT:
 
 class TestMoE:
 
+    @pytest.mark.slow
     def test_moe_trains_with_expert_parallel(self):
         cfg = MoEConfig(vocab_size=64, hidden_size=32, num_layers=2,
                         num_heads=4, seq_len=16, num_experts=4,

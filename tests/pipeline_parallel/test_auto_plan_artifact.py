@@ -19,6 +19,7 @@ CPU_DB = os.path.join(REPO, "prof_database_cpu8.json")
 
 @pytest.mark.skipif(not os.path.exists(ARTIFACT),
                     reason="no committed plan artifact")
+@pytest.mark.slow
 def test_gpt67b_plan_stable_under_checked_in_db():
     from benchmark.auto_search_artifact import search_gpt_plan
 

@@ -152,6 +152,7 @@ class TestPipeshard:
 
 class TestPipeshardGPT:
 
+    @pytest.mark.slow
     def test_gpt_pipeline(self):
         import optax
         from flax.training import train_state

@@ -204,6 +204,7 @@ def test_dp_reproduces_reference_balanced_solution_under_linear_scaling():
     assert part == [(0, 4, 3), (4, 8, 3)], part
 
 
+@pytest.mark.slow
 def test_v100_like_calibration_search_is_cost_balanced():
     """Full search under a V100/NVLink-like analytic calibration (6.7B,
     16 devices, 64 microbatches, 8 auto layers).  The analytic MXU

@@ -1,0 +1,419 @@
+"""CPU fault-injection tests: hung/failing probes, failed cross-mesh
+transfers, retry/backoff bounds, and the full recovery state machine
+(HEALTHY -> SUSPECT -> RECOVERING -> HEALTHY | DEGRADED).
+
+Everything here runs on the virtual 8-device CPU mesh — the point of
+``alpa_tpu.fault`` is that every recovery path is testable without a
+TPU, let alone a broken one.  See docs/fault_tolerance.md.
+"""
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from alpa_tpu import fault
+from alpa_tpu.fault import (FaultPlan, FaultSpec, InjectedFault, MeshHealth,
+                            RecoveryManager, RetryPolicy)
+from alpa_tpu.monitoring import FailureWatchdog, check_alive
+
+pytestmark = pytest.mark.fault
+
+FAST = RetryPolicy(max_attempts=2, base_delay=0.005, max_delay=0.02,
+                   jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    yield
+    fault.set_retry_policy(None)
+    for site in list(fault._SITE_POLICIES):
+        fault.set_retry_policy(None, site=site)
+    fault.retry_stats.clear()
+
+
+class _FakeMesh:
+    """Just enough mesh for check_alive: one real CPU device."""
+
+    def __init__(self):
+        self.flat_devices = [jax.devices("cpu")[0]]
+
+    def __repr__(self):
+        return "FakeMesh"
+
+
+class TestFaultPlan:
+
+    def test_error_injection_counts_and_events(self):
+        with FaultPlan(FaultSpec("s", times=2)) as plan:
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    fault.fire("s", k=1)
+            fault.fire("s", k=1)  # exhausted: no-op
+        assert plan.hits("s") == 3
+        assert plan.fired("s") == 2
+        assert [e[0] for e in plan.events] == ["s", "s"]
+        assert plan.events[0][2] == {"k": 1}
+
+    def test_after_skips_first_hits(self):
+        with FaultPlan(FaultSpec("s", after=2, times=1)) as plan:
+            fault.fire("s")
+            fault.fire("s")
+            with pytest.raises(InjectedFault):
+                fault.fire("s")
+        assert plan.fired("s") == 1
+
+    def test_match_targets_one_mesh(self):
+        spec = FaultSpec("s", times=-1,
+                         match=lambda info: info.get("mesh_id") == 1)
+        with FaultPlan(spec) as plan:
+            fault.fire("s", mesh_id=0)
+            with pytest.raises(InjectedFault):
+                fault.fire("s", mesh_id=1)
+        assert plan.fired("s") == 1
+
+    def test_slow_delays_then_continues(self):
+        with FaultPlan(FaultSpec("s", kind="slow", delay=0.05)):
+            t0 = time.monotonic()
+            fault.fire("s")
+            assert time.monotonic() - t0 >= 0.05
+
+    def test_no_plan_is_noop_and_uninstrumented(self):
+        fault.fire("anything", x=1)
+        assert not fault.instrumented()
+        with FaultPlan():
+            assert fault.instrumented()
+
+    def test_custom_exception_factory(self):
+        with FaultPlan(FaultSpec("s", exc=lambda: OSError("wire"))):
+            with pytest.raises(OSError):
+                fault.fire("s")
+
+
+class TestRetryPolicy:
+
+    def test_backoff_is_bounded_exponential(self):
+        pol = RetryPolicy(max_attempts=6, base_delay=0.01, multiplier=2.0,
+                          max_delay=0.05, jitter=0.0)
+        delays = [pol.backoff(k) for k in range(1, 6)]
+        assert delays[:3] == [0.01, 0.02, 0.04]
+        assert delays[3] == 0.05 and delays[4] == 0.05  # capped
+
+    def test_jitter_stays_within_fraction(self):
+        pol = RetryPolicy(base_delay=0.01, multiplier=1.0, jitter=0.5)
+        for k in range(1, 50):
+            d = pol.backoff(1)
+            assert 0.01 <= d <= 0.015 + 1e-12
+
+    def test_site_overrides(self):
+        pol = RetryPolicy(max_attempts=5,
+                          site_overrides={"probe": RetryPolicy(
+                              max_attempts=1)})
+        assert pol.for_site("probe").max_attempts == 1
+        assert pol.for_site("other").max_attempts == 5
+
+    def test_call_with_retry_recovers_from_injection(self):
+        calls = []
+
+        def op():
+            fault.fire("op")
+            calls.append(1)
+            return 42
+
+        with FaultPlan(FaultSpec("op", times=2)) as plan:
+            out = fault.call_with_retry(
+                op, policy=RetryPolicy(max_attempts=3, base_delay=0.001,
+                                       jitter=0.0), site="op")
+        assert out == 42 and len(calls) == 1
+        assert plan.retries["op"] == 2
+        assert len(plan.backoffs["op"]) == 2
+        assert fault.retry_stats["op"] == 2
+
+    def test_exhaustion_reraises_last_error(self):
+        with FaultPlan(FaultSpec("op", times=-1)):
+            with pytest.raises(InjectedFault):
+                fault.call_with_retry(
+                    lambda: fault.fire("op"),
+                    policy=RetryPolicy(max_attempts=3, base_delay=0.001,
+                                       jitter=0.0), site="op")
+
+    def test_non_idempotent_real_error_not_retried(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise ValueError("real failure after side effects")
+
+        with pytest.raises(ValueError):
+            fault.call_with_retry(
+                op, policy=RetryPolicy(max_attempts=5, base_delay=0.001),
+                site="op", idempotent=False)
+        assert len(calls) == 1  # never blindly re-run
+
+    def test_injected_fault_retryable_even_when_non_idempotent(self):
+        calls = []
+
+        def op():
+            fault.fire("op")  # fires BEFORE the real operation
+            calls.append(1)
+            return "ok"
+
+        with FaultPlan(FaultSpec("op", times=1)):
+            out = fault.call_with_retry(
+                op, policy=RetryPolicy(max_attempts=2, base_delay=0.001,
+                                       jitter=0.0),
+                site="op", idempotent=False)
+        assert out == "ok" and len(calls) == 1
+
+    def test_deadline_budget_stops_retrying(self):
+        t0 = time.monotonic()
+        with FaultPlan(FaultSpec("op", times=-1)):
+            with pytest.raises(InjectedFault):
+                fault.call_with_retry(
+                    lambda: fault.fire("op"),
+                    policy=RetryPolicy(max_attempts=100, base_delay=0.02,
+                                       multiplier=1.0, jitter=0.0,
+                                       deadline=0.1),
+                    site="op")
+        assert time.monotonic() - t0 < 1.0
+
+    def test_installed_policy_resolution(self):
+        fault.set_retry_policy(RetryPolicy(max_attempts=7), site="x")
+        assert fault.get_retry_policy("x").max_attempts == 7
+        assert fault.get_retry_policy("y").max_attempts == 1  # NO_RETRY
+        fault.set_retry_policy(None, site="x")
+        assert fault.get_retry_policy("x").max_attempts == 1
+
+
+class TestCheckAlive:
+
+    def test_healthy_mesh_passes(self):
+        assert check_alive(_FakeMesh(), timeout=10.0)
+
+    def test_hung_probe_detected_within_timeout(self):
+        """A wedged device (probe thread never returns) is reported dead
+        after ~timeout, not hung forever — the abandoned-thread design."""
+        with FaultPlan(FaultSpec("probe", kind="hang", delay=1.5)):
+            t0 = time.monotonic()
+            assert check_alive(_FakeMesh(), timeout=0.2) is False
+            assert time.monotonic() - t0 < 1.0
+
+    def test_probe_exception_means_dead(self):
+        with FaultPlan(FaultSpec("probe")):
+            assert check_alive(_FakeMesh(), timeout=1.0) is False
+
+    def test_probe_retry_policy_rides_out_transient(self):
+        with FaultPlan(FaultSpec("probe", times=1)) as plan:
+            assert check_alive(_FakeMesh(), timeout=5.0,
+                               retry_policy=FAST) is True
+        assert plan.retries["probe"] == 1
+
+
+class TestRecoveryStateMachine:
+    """The acceptance scenario: HEALTHY -> SUSPECT -> RECOVERING ->
+    HEALTHY with bounded retries, plus the DEGRADED paths."""
+
+    def _manager(self, mesh, **kw):
+        calls = {"quiesce": 0, "resume": 0, "snapshot": 0,
+                 "degrade": [], "recover": 0}
+        rm = RecoveryManager(
+            [mesh], retry_policy=FAST,
+            probe=lambda m: check_alive(m, timeout=0.3),
+            quiesce=lambda: calls.__setitem__(
+                "quiesce", calls["quiesce"] + 1),
+            resume=lambda: calls.__setitem__(
+                "resume", calls["resume"] + 1),
+            snapshot=lambda: calls.__setitem__(
+                "snapshot", calls["snapshot"] + 1),
+            on_degrade=lambda reason: calls["degrade"].append(reason),
+            on_recover=lambda: calls.__setitem__(
+                "recover", calls["recover"] + 1),
+            **kw)
+        return rm, calls
+
+    def test_full_recovery_cycle_with_bounded_retries(self):
+        """Probe fails long enough to reach RECOVERING (quiesce +
+        snapshot fire), then clears: the machine walks HEALTHY ->
+        SUSPECT -> RECOVERING -> HEALTHY and every re-probe attempt is
+        accounted and bounded."""
+        mesh = _FakeMesh()
+        rm, calls = self._manager(mesh)
+        # hit 1: watchdog round probe; hits 2-3: SUSPECT re-probe
+        # (max_attempts=2); hit 4: recovery probe -> clean
+        with FaultPlan(FaultSpec("probe", times=3)) as plan:
+            state = rm.tick()
+        assert state is MeshHealth.HEALTHY
+        assert [(o.value, n.value) for o, n, _ in rm.transitions] == [
+            ("healthy", "suspect"), ("suspect", "recovering"),
+            ("recovering", "healthy")]
+        assert calls["quiesce"] == 1 and calls["snapshot"] == 1
+        assert calls["resume"] == 1 and calls["recover"] == 1
+        assert calls["degrade"] == []
+        assert rm.snapshots_taken == 1
+        # bounded: exactly 4 probe attempts, with the extra attempts
+        # recorded per retry site
+        assert plan.hits("probe") == 4
+        assert plan.retries["probe"] == 1
+        assert plan.retries.get("recovery_probe") is None
+
+    def test_transient_blip_recovers_at_suspect(self):
+        mesh = _FakeMesh()
+        rm, calls = self._manager(mesh)
+        with FaultPlan(FaultSpec("probe", times=1)):
+            state = rm.tick()
+        assert state is MeshHealth.HEALTHY
+        assert calls["quiesce"] == 0  # never reached RECOVERING
+        assert [(o.value, n.value) for o, n, _ in rm.transitions] == [
+            ("healthy", "suspect"), ("suspect", "healthy")]
+
+    def test_unrecoverable_degrades_then_heals(self):
+        mesh = _FakeMesh()
+        rm, calls = self._manager(mesh)
+        with FaultPlan(FaultSpec("probe", times=-1)):
+            state = rm.tick()
+            assert state is MeshHealth.DEGRADED
+            assert calls["degrade"], "on_degrade must fire"
+            # stays degraded while the mesh is still dead
+            assert rm.tick() is MeshHealth.DEGRADED
+        # fault lifted: the next clean round restores service
+        assert rm.tick() is MeshHealth.HEALTHY
+        assert calls["recover"] >= 1 and calls["resume"] >= 1
+
+    def test_hooks_may_raise_without_killing_the_machine(self):
+        mesh = _FakeMesh()
+        rm = RecoveryManager(
+            [mesh], retry_policy=FAST,
+            probe=lambda m: check_alive(m, timeout=0.3),
+            quiesce=lambda: 1 / 0,
+            on_degrade=lambda reason: 1 / 0)
+        with FaultPlan(FaultSpec("probe", times=-1)):
+            assert rm.tick() is MeshHealth.DEGRADED
+        assert rm.tick() is MeshHealth.HEALTHY
+
+    def test_watchdog_drives_recovery_from_its_thread(self):
+        mesh = _FakeMesh()
+        rm, calls = self._manager(mesh)
+        wd = FailureWatchdog([mesh], interval=0.02, recovery=rm,
+                             probe_timeout=0.3)
+        seen_failure = []
+        wd.on_failure = lambda dead: seen_failure.append(list(dead))
+        with FaultPlan(FaultSpec("probe", times=-1)):
+            wd.start()
+            deadline = time.monotonic() + 20.0
+            while (rm.state is not MeshHealth.DEGRADED and
+                   time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert rm.state is MeshHealth.DEGRADED
+            assert seen_failure and seen_failure[0] == [0]
+        # plan exited: watchdog's next clean round recovers
+        deadline = time.monotonic() + 20.0
+        while (rm.state is not MeshHealth.HEALTHY and
+               time.monotonic() < deadline):
+            time.sleep(0.02)
+        wd.stop()
+        assert rm.state is MeshHealth.HEALTHY
+
+    def test_snapshotter_writes_restorable_checkpoint(self, tmp_path):
+        from alpa_tpu.serialization import restore_checkpoint
+        state = {"w": jnp.arange(4.0), "step": jnp.asarray(7)}
+        snap = fault.make_snapshotter(str(tmp_path), lambda: state)
+        rm = RecoveryManager([_FakeMesh()], retry_policy=FAST,
+                             probe=lambda m: check_alive(m, timeout=0.3),
+                             snapshot=snap)
+        with FaultPlan(FaultSpec("probe", times=3)):
+            assert rm.tick() is MeshHealth.HEALTHY
+        assert rm.snapshots_taken == 1
+        restored = restore_checkpoint(str(tmp_path), state)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(4.0))
+
+
+class TestCrossMeshTransferFaults:
+
+    def test_failed_transfer_retried_to_success(self):
+        """The pipeshard RESHARD contract: a transfer that fails once is
+        re-run under the ``cross_mesh_send`` retry site and lands."""
+        from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+            ReshardingTask)
+        dst = jax.sharding.SingleDeviceSharding(jax.devices("cpu")[1])
+        task = ReshardingTask(types.SimpleNamespace(requests=[]), dst)
+        arr = jnp.arange(8.0)
+        with FaultPlan(FaultSpec("cross_mesh_recv", times=1)) as plan:
+            out = fault.call_with_retry(
+                lambda: task.run(arr),
+                policy=RetryPolicy(max_attempts=3, base_delay=0.001,
+                                   jitter=0.0),
+                site="cross_mesh_send")
+        np.testing.assert_array_equal(np.asarray(out), np.arange(8.0))
+        assert list(out.devices())[0] == jax.devices("cpu")[1]
+        assert plan.fired("cross_mesh_recv") == 1
+        assert plan.retries["cross_mesh_send"] == 1
+
+
+class TestPipeshardFaults:
+    """End-to-end through the real pipeshard runtime on the 8-device
+    CPU mesh: stage launches retry through injected faults, and
+    quiesce/resume gate in-flight work."""
+
+    def _build(self):
+        import alpa_tpu
+        from alpa_tpu import PipeshardParallel
+        from alpa_tpu.pipeline_parallel.layer_construction import (
+            ManualLayerOption)
+        from alpa_tpu.pipeline_parallel.stage_construction import (
+            UniformStageOption)
+        from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                      get_mlp_train_step)
+        alpa_tpu.init(cluster="local")
+        state, batch = create_mlp_train_state_and_batch(
+            batch_size=64, num_layers=4, manual_pipeline_layer=True)
+        step = get_mlp_train_step(PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=ManualLayerOption(),
+            stage_option=UniformStageOption(num_stages=2),
+            pipeline_schedule="1f1b"), use_value_and_grad=True)
+        return state, batch, step
+
+    def test_stage_launch_fault_is_retried(self):
+        state, batch, step = self._build()
+        state, loss0 = step(state, batch)  # compile clean
+        fault.set_retry_policy(
+            RetryPolicy(max_attempts=3, base_delay=0.001, jitter=0.0),
+            site="stage_launch")
+        try:
+            with FaultPlan(FaultSpec("stage_launch", times=1)) as plan:
+                state, loss1 = step(state, batch)
+            assert plan.fired("stage_launch") == 1
+            assert plan.retries["stage_launch"] == 1
+        finally:
+            fault.set_retry_policy(None, site="stage_launch")
+        assert np.isfinite(float(loss1))
+        # and the step after the fault plan is gone still works
+        _, loss2 = step(state, batch)
+        assert np.isfinite(float(loss2))
+
+    def test_quiesce_blocks_new_launches_until_resume(self):
+        state, batch, step = self._build()
+        state, _ = step(state, batch)
+        ex = step.get_last_executable()
+        ex.quiesce(timeout=10.0)
+        started = threading.Event()
+        done = threading.Event()
+        result = {}
+
+        def blocked_step():
+            started.set()
+            result["out"] = step(state, batch)
+            done.set()
+
+        t = threading.Thread(target=blocked_step, daemon=True)
+        t.start()
+        started.wait(5.0)
+        # gate closed: the launch must not complete
+        assert not done.wait(0.3)
+        ex.resume()
+        assert done.wait(30.0), "resume() must release queued launches"
+        assert np.isfinite(float(result["out"][1]))

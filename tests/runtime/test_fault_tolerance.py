@@ -23,9 +23,9 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 WORKER = r"""
 import sys
 
+from alpa_tpu.platform import pin_cpu_platform
+pin_cpu_platform(8)
 import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
 import jax.numpy as jnp
 import numpy as np
 

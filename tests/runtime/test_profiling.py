@@ -60,6 +60,7 @@ class TestProfilingDatabase:
         abstract = LogicalDeviceMesh(None, np.arange(8).reshape(1, 8))
         assert abstract.all_reduce_cost(1e6, 1) > 1.0
 
+    @pytest.mark.slow
     def test_profile_one_mesh_measures(self):
         """Real measurement on the 8-device CPU mesh: dots + collectives
         recorded, fits positive."""

@@ -1,0 +1,227 @@
+"""Graceful degradation in the serving stack.
+
+The acceptance scenario: a scheduler exception mid-batch must not fail
+the queued requests — the batcher demotes itself to a fresh FIFO queue,
+carries every drained request over, and serves them all (ZERO collateral
+failures) while reporting degraded health.  Watchdog-driven load
+shedding: a RecoveryManager that gives up flips the controller to
+"shedding", new requests bounce with 503s, and recovery restores
+service.  See docs/fault_tolerance.md.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from alpa_tpu import fault
+from alpa_tpu.fault import (FaultPlan, FaultSpec, InjectedFault,
+                            ServiceDegradedError)
+from alpa_tpu.model.gpt_model import GPTConfig, init_gpt_real
+from alpa_tpu.serve import (Controller, GenerationConfig, Generator,
+                            run_controller)
+from alpa_tpu.serve.controller import RequestBatcher
+from alpa_tpu.serve.scheduler import FIFOQueue, WeightedFairQueue
+
+pytestmark = pytest.mark.fault
+
+
+def _tiny_generator(batch_size=1):
+    cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4, seq_len=32,
+                    vocab_size=64)
+    model, params = init_gpt_real(cfg, batch_size)
+    return Generator(model, params, cfg, batch_size)
+
+
+def _submit_many(batcher, n, max_new_tokens=3):
+    """Submit n requests from n threads; return (results, errors)."""
+    results, errors = [None] * n, [None] * n
+
+    def worker(i):
+        try:
+            results[i] = batcher.submit(
+                [np.array([1 + i, 2, 3], np.int32)],
+                GenerationConfig(max_new_tokens=max_new_tokens))
+        except Exception as e:  # pylint: disable=broad-except
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return results, errors
+
+
+class TestBatcherDegradedMode:
+
+    def test_take_fault_serves_all_queued_requests(self):
+        """THE acceptance criterion: scheduler exception during batch
+        formation -> every queued request still completes, zero
+        collateral failures, batcher reports degraded."""
+        batcher = RequestBatcher(_tiny_generator(4), max_batch=4,
+                                 scheduler=WeightedFairQueue())
+        with FaultPlan(FaultSpec("scheduler_take", times=1)) as plan:
+            results, errors = _submit_many(batcher, 5)
+        assert plan.fired("scheduler_take") == 1
+        assert errors == [None] * 5, f"collateral failures: {errors}"
+        assert all(r is not None for r in results)
+        assert batcher.degraded
+        assert "InjectedFault" in batcher.degraded_reason
+        # the replacement queue is a plain FIFO
+        assert isinstance(batcher._queue, FIFOQueue)
+
+    def test_broken_scheduler_object_degrades_once(self):
+        """A custom policy whose take() itself raises: after the first
+        failure the FIFO fallback owns the queue — the broken object is
+        never consulted again and requests flow normally."""
+
+        class BrokenQueue(FIFOQueue):
+            take_calls = 0
+
+            def take(self, selector):
+                BrokenQueue.take_calls += 1
+                raise RuntimeError("policy bug")
+
+        batcher = RequestBatcher(_tiny_generator(2), max_batch=2,
+                                 scheduler=BrokenQueue())
+        results, errors = _submit_many(batcher, 3)
+        assert errors == [None] * 3
+        assert all(r is not None for r in results)
+        assert batcher.degraded
+        assert BrokenQueue.take_calls == 1
+        # still serving post-degradation
+        more, errs = _submit_many(batcher, 2)
+        assert errs == [None] * 2 and all(r is not None for r in more)
+
+    def test_on_degraded_callback_fires_once(self):
+        batcher = RequestBatcher(_tiny_generator(2), max_batch=2)
+        seen = []
+        batcher.on_degraded = seen.append
+        with FaultPlan(FaultSpec("scheduler_take", times=2)):
+            _, errors = _submit_many(batcher, 2)
+        assert errors == [None, None]
+        assert len(seen) == 1
+        assert isinstance(seen[0], InjectedFault)
+
+    def test_healthy_batcher_unchanged(self):
+        batcher = RequestBatcher(_tiny_generator(2), max_batch=2)
+        results, errors = _submit_many(batcher, 3)
+        assert errors == [None] * 3 and all(r is not None
+                                            for r in results)
+        assert not batcher.degraded
+
+
+class TestEngineTickFaults:
+
+    def test_mid_decode_fault_fails_batch_but_engine_survives(self):
+        """A decode-tick exception loses in-flight rows (their KV state
+        is gone — failing them is correct), but the engine thread stays
+        alive and serves the NEXT requests."""
+        from alpa_tpu.serve.engine import ContinuousBatchingEngine
+        eng = ContinuousBatchingEngine(_tiny_generator(1), max_batch=1)
+        try:
+            with FaultPlan(FaultSpec("scheduler_tick", times=1)) as plan:
+                with pytest.raises(InjectedFault):
+                    eng.submit(np.array([1, 2], np.int32),
+                               GenerationConfig(max_new_tokens=3))
+                assert plan.fired("scheduler_tick") == 1
+            assert eng.step_failures == 1
+            out = eng.submit(np.array([3, 4], np.int32),
+                             GenerationConfig(max_new_tokens=3))
+            assert len(out) == 5
+        finally:
+            eng.shutdown()
+
+
+class TestControllerShedding:
+
+    def test_shedding_rejects_then_recovers(self):
+        controller = Controller()
+        controller.register_model("tiny", _tiny_generator())
+        req = {"model": "tiny", "prompt_ids": [1, 2, 3],
+               "max_new_tokens": 2}
+        assert controller.completions(req)["output_ids"]
+        controller.set_health("shedding", "mesh 0 unrecovered")
+        with pytest.raises(ServiceDegradedError):
+            controller.completions(req)
+        with pytest.raises(ServiceDegradedError):
+            controller.completions_stream(req)
+        assert controller.health_report()["status"] == "shedding"
+        controller.set_health("ok")
+        assert controller.completions(req)["output_ids"]
+
+    def test_attach_recovery_drives_shedding(self):
+        """RecoveryManager DEGRADED -> controller sheds; recovery ->
+        service restored.  This is the watchdog-to-serving wire."""
+        from alpa_tpu.fault import MeshHealth, RecoveryManager, RetryPolicy
+        controller = Controller()
+        controller.register_model("tiny", _tiny_generator())
+        req = {"model": "tiny", "prompt_ids": [1, 2], "max_new_tokens": 2}
+        alive = {"ok": True}
+        rm = RecoveryManager(
+            [object()],
+            retry_policy=RetryPolicy(max_attempts=2, base_delay=0.001,
+                                     jitter=0.0),
+            probe=lambda mesh: alive["ok"])
+        controller.attach_recovery(rm)
+        alive["ok"] = False
+        assert rm.tick() is MeshHealth.DEGRADED
+        assert controller.health_report()["status"] == "shedding"
+        with pytest.raises(ServiceDegradedError):
+            controller.completions(req)
+        alive["ok"] = True
+        assert rm.tick() is MeshHealth.HEALTHY
+        assert controller.health_report()["status"] == "ok"
+        assert controller.completions(req)["output_ids"]
+
+    def test_degraded_batcher_surfaces_in_health_report(self):
+        controller = Controller()
+        controller.register_model("tiny", _tiny_generator(2),
+                                  scheduler_factory=WeightedFairQueue)
+        replica = controller._models["tiny"][0]
+        with FaultPlan(FaultSpec("scheduler_take", times=1)):
+            _, errors = _submit_many(replica.batcher, 2)
+        assert errors == [None, None]
+        report = controller.health_report()
+        assert report["status"] == "degraded"
+        assert report["degraded_models"] == ["tiny"]
+
+
+class TestHTTPShedding:
+
+    def test_503_and_health_endpoint(self):
+        server = run_controller(port=0)
+        try:
+            server.controller.register_model("tiny", _tiny_generator())
+            base = f"http://127.0.0.1:{server.port}"
+            body = json.dumps({"model": "tiny", "prompt_ids": [1, 2],
+                               "max_new_tokens": 2}).encode()
+
+            def post():
+                return urllib.request.urlopen(urllib.request.Request(
+                    base + "/completions", data=body,
+                    headers={"Content-Type": "application/json"}))
+
+            with post() as r:
+                assert r.status == 200
+            server.controller.set_health("shedding", "recovering")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post()
+            assert e.value.code == 503
+            assert "unavailable" in json.loads(
+                e.value.read())["error"]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(base + "/health")
+            assert e.value.code == 503
+            assert json.loads(e.value.read())["status"] == "shedding"
+            server.controller.set_health("ok")
+            with post() as r:
+                assert r.status == 200
+            with urllib.request.urlopen(base + "/health") as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            server.shutdown()

@@ -73,6 +73,30 @@ class TestAcceptanceMath:
         assert p[1] > 0 and p[2] > 0 and p[4] == 0.0
         np.testing.assert_allclose(p.sum(), 1.0)
 
+    def test_top_k_mask_constant_unified(self):
+        """The device sampler and the host warper share ONE mask
+        constant, and it is -inf: a finite sentinel (the old -1e9)
+        leaves masked tokens with tiny-but-nonzero device probability
+        while the host assigns exactly zero — speculative acceptance
+        p/q is only exact when both agree on the support."""
+        import jax
+        import jax.numpy as jnp
+
+        from alpa_tpu.serve import generation
+
+        assert generation.TOP_K_MASK == float("-inf")
+        logits = np.array([1.0, 3.0, 3.0, 0.0, 2.0], np.float32)
+        cfg = GenerationConfig(do_sample=True, top_k=2)
+        # device-path probabilities under exactly _sample_logits' warp
+        x = jnp.asarray(logits)
+        kth = jax.lax.top_k(x, cfg.top_k)[0][..., -1:]
+        dev_p = np.asarray(jax.nn.softmax(
+            jnp.where(x < kth, generation.TOP_K_MASK, x)), np.float64)
+        host_p = _warp_probs_np(logits, cfg)
+        # identical support: zero exactly where the other is zero
+        np.testing.assert_array_equal(dev_p == 0.0, host_p == 0.0)
+        np.testing.assert_allclose(dev_p, host_p, atol=1e-6)
+
 
 class TestEndToEndSampled:
 

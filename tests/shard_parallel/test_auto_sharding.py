@@ -403,6 +403,7 @@ class TestConstraintEmission:
         g = [st for st in sts if "'g'" in st.name][0]
         assert g.comm_cost == 0.0, "group sharding needs no collective"
 
+    @pytest.mark.slow
     def test_wresnet_conv_planner_chooses_parallelism(self):
         """Convolutions get real strategies (batch/channel roles), not
         replication barriers: the planner must shard the image batch."""

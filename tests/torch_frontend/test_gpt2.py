@@ -232,5 +232,56 @@ class TestDropoutPolicy:
             rtol=1e-5, atol=1e-5)
 
 
+class TestLeafDropoutRefusal:
+    """The leaf-module escape hatch must not evade the explicit dropout
+    policy: GPT2Block converts as a LEAF (the tracer never sees its
+    nn.Dropout children, so _find_active_dropout cannot), and the leaf
+    mapping is deterministic — converting a train-mode block with live
+    dropout would silently mistrain.  Regression for that gap."""
+
+    def _gpt2(self, attn_pdrop, resid_pdrop, train):
+        cfg = transformers.GPT2Config(
+            n_layer=1, n_embd=32, n_head=2, vocab_size=64,
+            n_positions=32, attn_pdrop=attn_pdrop,
+            resid_pdrop=resid_pdrop, embd_pdrop=0.0,
+            attn_implementation="eager")
+        torch.manual_seed(0)
+        m = transformers.GPT2LMHeadModel(cfg)
+        return m.train() if train else m.eval()
+
+    def _convert(self, model):
+        from transformers.models.gpt2.modeling_gpt2 import GPT2Block
+        wrapper = GPT2Wrapper(model)
+        wrapper.train(model.training)
+        return functionalize(wrapper, leaf_modules=(GPT2Block,),
+                             dropout="identity")
+
+    def test_train_mode_block_with_pdrop_refuses(self):
+        model = self._gpt2(attn_pdrop=0.1, resid_pdrop=0.1, train=True)
+        with pytest.raises(ValueError, match="active dropout"):
+            self._convert(model)
+
+    def test_train_mode_resid_dropout_alone_refuses(self):
+        model = self._gpt2(attn_pdrop=0.0, resid_pdrop=0.1, train=True)
+        with pytest.raises(ValueError, match="resid_dropout"):
+            self._convert(model)
+
+    def test_zero_pdrop_train_block_converts(self):
+        model = self._gpt2(attn_pdrop=0.0, resid_pdrop=0.0, train=True)
+        fn, params = self._convert(model)
+        ids = np.arange(4, dtype=np.int64)[None]
+        out = fn(params, jnp.asarray(ids), jnp.asarray(_causal_mask(4)))
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_eval_block_with_pdrop_converts_and_matches(self):
+        model = self._gpt2(attn_pdrop=0.1, resid_pdrop=0.1, train=False)
+        fn, params = self._convert(model)
+        ids = np.arange(4, dtype=np.int64)[None]
+        want = model(torch.tensor(ids)).logits.detach().numpy()
+        got = np.asarray(fn(params, jnp.asarray(ids),
+                            jnp.asarray(_causal_mask(4))))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
 if __name__ == "__main__":
     pytest.main([__file__, "-x", "-q"])
