@@ -218,6 +218,8 @@ class ParallelizedFunc:
         executable = self.method.compile_executable(flat_fun, avals, in_tree,
                                                     in_paths, donated_invars,
                                                     batch_invars)
+        self._save_parallel_plan(executable, avals, in_paths, batch_invars,
+                                 donated_invars)
         if out_tree_store[0] is None:
             # method didn't trace eagerly; force one abstract eval
             jax.eval_shape(flat_fun, *avals)
@@ -226,6 +228,42 @@ class ParallelizedFunc:
             self._executable_cache[key] = executable
         self._last_executable = executable
         return executable, flat_args
+
+    def _save_parallel_plan(self, executable, avals, in_paths, batch_invars,
+                            donated_invars):
+        """Persist the replayable ParallelPlan artifact of this compile in
+        the ``parallel_plan`` cache namespace (ISSUE 2): a warm restart can
+        rebuild the ParallelMethod from the plan (``plan_to_method``)
+        without re-running stage construction or the ILP, and
+        ``scripts/cache_tool.py`` can inspect what was compiled.  Purely
+        archival — failures never break compilation."""
+        from alpa_tpu.compile_cache import cache_enabled, get_compile_cache
+        if not cache_enabled():
+            return
+        try:
+            from alpa_tpu.parallel_plan import executable_to_plan
+            plan = executable_to_plan(
+                executable,
+                num_micro_batches=getattr(self.method, "num_micro_batches",
+                                          None))
+            cache = get_compile_cache()
+            method_desc = "{}({})".format(
+                type(self.method).__name__,
+                ",".join(f"{k}={v!r}" for k, v in
+                         sorted(vars(self.method).items())))
+            key = cache.make_key("parallel_plan", [
+                "parallelize",
+                getattr(self.fun, "__module__", "?"),
+                getattr(self.fun, "__qualname__", repr(self.fun)),
+                repr([str(a) for a in avals]),
+                repr(tuple(in_paths)),
+                repr(tuple(batch_invars)),
+                repr(tuple(donated_invars)),
+                method_desc,
+            ])
+            cache.put("parallel_plan", key, plan)
+        except Exception:  # pylint: disable=broad-except
+            logger.debug("parallel_plan artifact save failed", exc_info=True)
 
     def __call__(self, *args):
         executable, flat_args = self.get_executable(*args)

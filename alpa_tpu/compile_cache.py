@@ -1,0 +1,301 @@
+"""Persistent compile cache: content-addressed, two-tier (memory + disk).
+
+Every process restart used to re-run layer clustering, stage DP, and the
+per-stage ILP from scratch (ISSUE 2): the solver output is a pure function
+of (jaxpr, mesh shape, AutoShardingOption, jax version), so it is safe to
+persist and replay.  This module provides the shared cache those compile
+phases write through:
+
+* ``ilp`` namespace — auto-sharding solutions from
+  ``shard_parallel/solver.py::plan_auto_sharding`` (chosen logical mesh
+  shape + the one-hot strategy vector).
+* ``stage_dp`` namespace — stage-construction decisions from
+  ``stage_construction.py::cluster_layers_and_slice_mesh`` (layer->stage
+  clustering + submesh shapes + per-stage autosharding dicts).
+* ``parallel_plan`` namespace — replayable ``ParallelPlan`` artifacts
+  saved by ``api.parallelize`` after each compile.
+
+Keying: sha256 over a canonical fingerprint of every input that shapes the
+answer, ALWAYS including ``jax.__version__`` and a format version — a jax
+upgrade or a cache-layout change invalidates everything, never corrupts.
+
+Tiers: an in-memory LRU (process lifetime) in front of an on-disk pickle
+store under ``global_config.compile_cache_dir`` (env ``ALPA_TPU_CACHE_DIR``).
+With no directory configured the cache is memory-only: warm *in-process*
+recompiles still hit, nothing touches the filesystem, and tests stay
+hermetic.  Disk writes are atomic (tempfile + rename) so concurrent
+processes sharing a cache dir can only ever read complete entries.
+
+Counters (hits / misses / puts / solve seconds spent vs saved) are
+per-namespace and surfaced through ``monitoring.get_compile_cache_stats``.
+"""
+import collections
+import dataclasses
+import hashlib
+import logging
+import os
+import pickle
+import re
+import tempfile
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+# ``str(jaxpr)`` embeds live function addresses (e.g. custom_jvp's
+# ``jvp_jaxpr_thunk=<function ... at 0x7f...>``); mask them so the same
+# program fingerprints identically across traces and processes.
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+# Bump to invalidate every persisted entry on cache-format changes.
+CACHE_FORMAT_VERSION = 1
+
+
+def _jax_version() -> str:
+    import jax
+    return jax.__version__
+
+
+def fingerprint_parts(parts: Sequence[Any]) -> str:
+    """Canonical content fingerprint of heterogeneous key parts.
+
+    Strings pass through; dataclasses expand to sorted field reprs (stable
+    across processes, unlike default ``repr`` which may embed addresses);
+    everything else uses ``repr``.  Each part is length-prefixed so
+    adjacent parts cannot collide by concatenation.
+    """
+    h = hashlib.sha256()
+    h.update(f"v{CACHE_FORMAT_VERSION}:jax={_jax_version()}".encode())
+    for p in parts:
+        if dataclasses.is_dataclass(p) and not isinstance(p, type):
+            s = "{}({})".format(
+                type(p).__name__,
+                ",".join(f"{k}={v!r}" for k, v in
+                         sorted(dataclasses.asdict(p).items())))
+        elif isinstance(p, str):
+            s = p
+        else:
+            s = repr(p)
+        b = _ADDR_RE.sub("0x0", s).encode()
+        h.update(f"|{len(b)}|".encode())
+        h.update(b)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass
+class NamespaceStats:
+    """Hit/miss accounting for one cache namespace."""
+    hits: int = 0
+    misses: int = 0
+    puts: int = 0
+    disk_hits: int = 0
+    # seconds spent producing entries that were then stored (the cost a
+    # future hit avoids) and seconds a hit demonstrably skipped
+    solve_seconds: float = 0.0
+    saved_seconds: float = 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "puts": self.puts,
+            "disk_hits": self.disk_hits,
+            "solve_seconds": round(self.solve_seconds, 4),
+            "saved_seconds": round(self.saved_seconds, 4),
+        }
+
+
+class CompileCache:
+    """Two-tier (LRU memory + optional disk) content-addressed cache."""
+
+    def __init__(self, cache_dir: Optional[str] = None,
+                 memory_entries: int = 128):
+        self.cache_dir = cache_dir or None
+        self.memory_entries = memory_entries
+        self._mem: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+        self._stats: Dict[str, NamespaceStats] = {}
+
+    # -- keying --------------------------------------------------------
+
+    def make_key(self, namespace: str, parts: Sequence[Any]) -> str:
+        return f"{namespace}-{fingerprint_parts(parts)}"
+
+    # -- stats ---------------------------------------------------------
+
+    def _ns_stats(self, namespace: str) -> NamespaceStats:
+        return self._stats.setdefault(namespace, NamespaceStats())
+
+    def record_solve_seconds(self, namespace: str, seconds: float):
+        with self._lock:
+            self._ns_stats(namespace).solve_seconds += seconds
+
+    def record_saved_seconds(self, namespace: str, seconds: float):
+        with self._lock:
+            self._ns_stats(namespace).saved_seconds += seconds
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "cache_dir": self.cache_dir,
+                "memory_entries": len(self._mem),
+                "namespaces": {ns: s.as_dict()
+                               for ns, s in sorted(self._stats.items())},
+            }
+
+    # -- storage -------------------------------------------------------
+
+    def _path_of(self, key: str) -> Optional[str]:
+        if not self.cache_dir:
+            return None
+        return os.path.join(self.cache_dir, key + ".pkl")
+
+    def get(self, namespace: str, key: str) -> Optional[Any]:
+        """The cached value, or None.  Memory tier first, then disk;
+        a disk hit is promoted into the memory tier."""
+        with self._lock:
+            if key in self._mem:
+                self._mem.move_to_end(key)
+                self._ns_stats(namespace).hits += 1
+                return self._mem[key]
+        path = self._path_of(key)
+        if path and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    value = pickle.load(f)
+            except Exception as e:  # pylint: disable=broad-except
+                # a truncated/stale entry is a miss, never an error
+                logger.warning("compile cache entry %s unreadable (%s); "
+                               "dropping", path, e)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                with self._lock:
+                    self._ns_stats(namespace).misses += 1
+                return None
+            with self._lock:
+                st = self._ns_stats(namespace)
+                st.hits += 1
+                st.disk_hits += 1
+                self._insert_mem(key, value)
+            return value
+        with self._lock:
+            self._ns_stats(namespace).misses += 1
+        return None
+
+    def put(self, namespace: str, key: str, value: Any):
+        with self._lock:
+            self._insert_mem(key, value)
+            self._ns_stats(namespace).puts += 1
+        path = self._path_of(key)
+        if not path:
+            return
+        try:
+            os.makedirs(self.cache_dir, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=self.cache_dir,
+                                       prefix=".tmp-" + namespace)
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump(value, f)
+                os.replace(tmp, path)  # atomic publish
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+        except Exception as e:  # pylint: disable=broad-except
+            # the disk tier is an optimization; a read-only or full disk
+            # must never fail compilation
+            logger.warning("compile cache write %s failed: %s", path, e)
+
+    def _insert_mem(self, key: str, value: Any):
+        self._mem[key] = value
+        self._mem.move_to_end(key)
+        while len(self._mem) > self.memory_entries:
+            self._mem.popitem(last=False)
+
+    # -- maintenance ---------------------------------------------------
+
+    def entries(self) -> List[Dict[str, Any]]:
+        """Disk-tier inventory (for scripts/cache_tool.py)."""
+        out = []
+        if not self.cache_dir or not os.path.isdir(self.cache_dir):
+            return out
+        for name in sorted(os.listdir(self.cache_dir)):
+            if not name.endswith(".pkl"):
+                continue
+            path = os.path.join(self.cache_dir, name)
+            ns, _, rest = name.rpartition(".pkl")[0].partition("-")
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append({
+                "namespace": ns,
+                "key": rest,
+                "bytes": st.st_size,
+                "mtime": st.st_mtime,
+                "path": path,
+            })
+        return out
+
+    def clear(self, namespace: Optional[str] = None,
+              memory_only: bool = False) -> int:
+        """Drop entries (all, or one namespace).  Returns the number of
+        disk entries removed."""
+        with self._lock:
+            if namespace is None:
+                self._mem.clear()
+            else:
+                for k in [k for k in self._mem
+                          if k.startswith(namespace + "-")]:
+                    del self._mem[k]
+        removed = 0
+        if memory_only:
+            return removed
+        for e in self.entries():
+            if namespace is None or e["namespace"] == namespace:
+                try:
+                    os.remove(e["path"])
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+
+# ---------------------------------------------------------------------
+# process-global instance
+# ---------------------------------------------------------------------
+
+_global_cache: Optional[CompileCache] = None
+_global_lock = threading.Lock()
+
+
+def get_compile_cache() -> CompileCache:
+    """The process-global cache, built from ``global_config`` on first
+    use.  ``reset_compile_cache()`` rebuilds it (tests; dir changes)."""
+    global _global_cache
+    with _global_lock:
+        if _global_cache is None:
+            from alpa_tpu.global_env import global_config
+            _global_cache = CompileCache(
+                cache_dir=global_config.compile_cache_dir,
+                memory_entries=global_config.compile_cache_memory_entries)
+        return _global_cache
+
+
+def reset_compile_cache(cache: Optional[CompileCache] = None):
+    """Install ``cache`` (or lazily rebuild from global_config).  Used by
+    the pytest fixture to isolate the cache dir per test, and by callers
+    after changing ``global_config.compile_cache_dir``."""
+    global _global_cache
+    with _global_lock:
+        _global_cache = cache
+
+
+def cache_enabled() -> bool:
+    from alpa_tpu.global_env import global_config
+    return bool(global_config.compile_cache_enabled)

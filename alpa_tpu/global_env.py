@@ -62,13 +62,18 @@ class GlobalConfig:
         # "normal" | "no_loadbalance".
         self.resharding_loadbalance_mode = os.environ.get(
             "ALPA_TPU_RESHARDING_LOADBALANCE", "normal")
-        # Pipeline instruction dispatch: "auto" | "sequential" | "threaded".
+        # Pipeline instruction dispatch:
+        # "auto" | "registers" | "sequential" | "threaded".
+        # "registers" replays the build-time register-file lowering (flat
+        # slot buffers + precomputed index tuples + cached resharding
+        # executors — no dict hashing or sharding resolution per call);
         # "threaded" runs the emitter's per-mesh instruction streams on
         # worker threads (the per-host stream analog of ref
-        # runtime_emitter's per-worker lists); "auto" uses it for
-        # single-process multi-mesh runs.  Multi-process always dispatches
-        # sequentially: collectives must be issued in the same order on
-        # every process.
+        # runtime_emitter's per-worker lists); "auto" picks registers when
+        # eligible (single process, device_put resharding, no fault/trace/
+        # race instrumentation) and falls back to the interpreter
+        # otherwise.  Multi-process always dispatches sequentially:
+        # collectives must be issued in the same order on every process.
         self.pipeline_dispatch_mode = os.environ.get(
             "ALPA_TPU_PIPELINE_DISPATCH", "auto")
         # Runtime race detection for threaded dispatch: every worker
@@ -89,6 +94,19 @@ class GlobalConfig:
         # Whether pipeshard runtime overlaps resharding with compute by
         # issuing transfers as soon as producers finish (async dispatch).
         self.overlap_resharding = True
+
+        # ---------- compile cache ----------
+        # On-disk tier of the persistent compile cache (ILP auto-sharding
+        # solutions, stage-DP decisions, parallel_plan artifacts — see
+        # alpa_tpu/compile_cache.py).  Unset = memory-only cache; set a
+        # directory to make warm restarts skip the solvers.
+        self.compile_cache_dir = os.environ.get("ALPA_TPU_CACHE_DIR", None)
+        # Master switch for the compile cache (both tiers).
+        self.compile_cache_enabled = _env_bool(
+            "ALPA_TPU_COMPILE_CACHE", True)
+        # In-memory LRU capacity (entries) of the compile cache.
+        self.compile_cache_memory_entries = int(os.environ.get(
+            "ALPA_TPU_COMPILE_CACHE_MEM_ENTRIES", "128"))
 
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS

@@ -159,4 +159,29 @@ def dump_debug_info(executable, dump_dir: str):
         write("instructions.txt", executable.get_instruction_text())
     if hasattr(executable, "get_resharding_report"):
         write("resharding.txt", executable.get_resharding_report())
+    write("compile_cache.txt", format_compile_cache_report())
     logger.info("debug info dumped to %s", dump_dir)
+
+
+def get_compile_cache_stats() -> dict:
+    """Hit/miss/solve-time counters of the persistent compile cache
+    (ISSUE 2), per namespace (``ilp`` / ``stage_dp`` / ``parallel_plan``).
+    See alpa_tpu/compile_cache.py."""
+    from alpa_tpu.compile_cache import get_compile_cache
+    return get_compile_cache().stats()
+
+
+def format_compile_cache_report() -> str:
+    """Human-readable one-namespace-per-line cache report (used by
+    scripts/cache_tool.py stat and debug dumps)."""
+    stats = get_compile_cache_stats()
+    lines = [f"compile cache dir: {stats['cache_dir'] or '(memory only)'}",
+             f"memory entries: {stats['memory_entries']}"]
+    for ns, s in stats["namespaces"].items():
+        lines.append(
+            f"  {ns:<14} hits={s['hits']} (disk={s['disk_hits']}) "
+            f"misses={s['misses']} puts={s['puts']} "
+            f"solve={s['solve_seconds']}s saved={s['saved_seconds']}s")
+    if not stats["namespaces"]:
+        lines.append("  (no cache traffic yet)")
+    return "\n".join(lines)

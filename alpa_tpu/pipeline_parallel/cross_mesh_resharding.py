@@ -248,6 +248,109 @@ def naive_transfer_bytes(shape, itemsize, dst_sharding) -> float:
 _warned_fallback = False
 
 
+def shard_structures_match(shape, src_sharding, dst_sharding) -> bool:
+    """True when moving ``src_sharding -> dst_sharding`` is a pure 1:1
+    shard move: each source shard maps onto the destination shard at the
+    same position in the device-assignment order (same per-shard index
+    maps).  That is exactly the case the runtime's batched C++ copy
+    (``batched_copy_array_to_devices_with_sharding``) handles without any
+    resharding logic; every other move needs the full device_put path."""
+    try:
+        src_map = src_sharding.devices_indices_map(tuple(shape))
+        dst_map = dst_sharding.devices_indices_map(tuple(shape))
+    except Exception:  # pylint: disable=broad-except
+        return False
+    return list(src_map.values()) == list(dst_map.values())
+
+
+class DirectTransfer:
+    """Pre-resolved, reusable executor for one RESHARD edge (ISSUE 2:
+    "plan once, replay as pre-resolved tasks", arXiv:2211.05322).
+
+    Built once at instruction-lowering time from the emitter's static
+    sharding model; ``__call__`` does no planning — the destination
+    devices, sharding, and path choice are already resolved:
+
+    * fast path: when the edge is a 1:1 shard-structure move (see
+      :func:`shard_structures_match`) the transfer goes straight to the
+      runtime's batched C++ copy, skipping device_put's sharding
+      resolution (~3x cheaper on the 8-device CPU mesh);
+    * fallback: ``jax.device_put`` with the pre-resolved dst sharding.
+
+    A per-call guard (``is_equivalent_to``, ~2 us) confirms the runtime
+    array still has the sharding the plan assumed; divergence silently
+    takes the fallback, so the fast path can never assemble wrong values.
+    """
+
+    __slots__ = ("dst_sharding", "src_sharding", "ndim", "fast",
+                 "_dst_devices", "_semantics")
+
+    def __init__(self, aval, src_sharding, dst_sharding):
+        self.dst_sharding = dst_sharding
+        self.src_sharding = src_sharding
+        self.ndim = len(getattr(aval, "shape", ()))
+        shape = tuple(getattr(aval, "shape", ()))
+        self.fast = (src_sharding is not None and shard_structures_match(
+            shape, src_sharding, dst_sharding))
+        self._dst_devices = None
+        self._semantics = None
+        if self.fast:
+            try:
+                import jaxlib.xla_extension as xe
+                self._dst_devices = list(
+                    dst_sharding._addressable_device_assignment)
+                self._semantics = xe.ArrayCopySemantics.ALWAYS_COPY
+            except Exception:  # pylint: disable=broad-except
+                self.fast = False
+
+    def __call__(self, val):
+        if self.fast:
+            try:
+                if val.sharding.is_equivalent_to(self.src_sharding,
+                                                 self.ndim):
+                    import jaxlib.xla_extension as xe
+                    return xe.batched_copy_array_to_devices_with_sharding(
+                        [val], [self._dst_devices], [self.dst_sharding],
+                        [self._semantics])[0]
+            except Exception:  # pylint: disable=broad-except
+                pass
+        import jax
+        return jax.device_put(val, self.dst_sharding)
+
+
+class DirectTransferGroup:
+    """Several :class:`DirectTransfer` edges between the same mesh pair,
+    coalesced into one call (adjacent same-edge transfers in the
+    instruction stream).  All-fast groups go through one batched C++
+    copy; mixed groups batch the fallback through a single
+    ``jax.device_put`` call (one runtime round-trip instead of N)."""
+
+    __slots__ = ("transfers", "all_fast")
+
+    def __init__(self, transfers: Sequence[DirectTransfer]):
+        self.transfers = list(transfers)
+        self.all_fast = all(t.fast for t in self.transfers)
+
+    def __len__(self):
+        return len(self.transfers)
+
+    def __call__(self, vals):
+        ts = self.transfers
+        if self.all_fast:
+            try:
+                if all(v.sharding.is_equivalent_to(t.src_sharding, t.ndim)
+                       for v, t in zip(vals, ts)):
+                    import jaxlib.xla_extension as xe
+                    return xe.batched_copy_array_to_devices_with_sharding(
+                        list(vals), [t._dst_devices for t in ts],
+                        [t.dst_sharding for t in ts],
+                        [t._semantics for t in ts])
+            except Exception:  # pylint: disable=broad-except
+                pass
+        import jax
+        return jax.device_put(list(vals), [t.dst_sharding for t in ts])
+
+
 @dataclasses.dataclass
 class ExecutionReport:
     """Bytes actually moved by one ``ReshardingTask.run`` call.

@@ -429,11 +429,16 @@ class PipeshardDriverExecutable:
                 if src_sh is not None and hasattr(v.aval, "shape"):
                     try:
                         from alpa_tpu.pipeline_parallel. \
-                            cross_mesh_resharding import plan_resharding
+                            cross_mesh_resharding import (ReshardingTask,
+                                                          plan_resharding)
                         inst.plan = plan_resharding(
                             tuple(v.aval.shape), v.aval.dtype.itemsize,
                             src_sh, dst_sharding)
                         self._resharding_bytes += inst.plan.transfer_bytes
+                        # pre-built, reusable executor: planned execution
+                        # modes replay this task every step instead of
+                        # re-resolving it on the hot path
+                        inst.task = ReshardingTask(inst.plan, dst_sharding)
                     except Exception as e:  # pylint: disable=broad-except
                         # the planned execution mode silently degrades to
                         # device_put for this transfer — keep it visible
@@ -548,6 +553,14 @@ class PipeshardDriverExecutable:
         self._acct_lock = threading.Lock()
         self._const_cache = None
         self._zero_exec_cache = None
+        # register-file replay fast path (built lazily on first eligible
+        # launch; see _ensure_lowered)
+        self._register_program = None
+        self._reg_input_loads = None
+        self._reg_const_loads = None
+        self._reg_acc_slots = None
+        self._reg_output_specs = None
+        self._warned_register_fallback = False
         # quiesce gate: fault.RecoveryManager pauses new launches and
         # waits out in-flight ones before snapshotting driver state
         self._launch_gate = threading.Event()
@@ -609,6 +622,26 @@ class PipeshardDriverExecutable:
                 "global_config.resharding_execution must be 'device_put' "
                 f"or 'planned', got {exec_mode!r}")
         multiprocess = jax.process_count() > 1
+        # Register-file replay fast path (ISSUE 2): the lowered program
+        # does no dict hashing / sharding resolution per call, but cannot
+        # carry fault hooks, trace collection, race checking, planned
+        # resharding, or the multi-process collective-order contract —
+        # those launches take the interpreter below.
+        dmode = getattr(global_config, "pipeline_dispatch_mode", "auto")
+        reg_ok = (not multiprocess and exec_mode == "device_put" and
+                  not fault.instrumented() and
+                  not global_config.collect_trace and
+                  not global_config.debug_dispatch_races)
+        if dmode == "registers" and not reg_ok and \
+                not self._warned_register_fallback:
+            self._warned_register_fallback = True
+            logger.warning(
+                "pipeline_dispatch_mode='registers' requested but the "
+                "launch is not eligible (multiprocess, planned resharding, "
+                "fault/trace/race instrumentation); falling back to the "
+                "instruction interpreter")
+        if reg_ok and dmode in ("auto", "registers"):
+            return self._launch_registers(flat_args)
         # multiprocess + "planned": cross-process RESHARD instructions
         # drive the tile plan via ReshardingTask.run_multiprocess (packed
         # tiles cross the boundary, not a full-array gather); everything
@@ -663,21 +696,7 @@ class PipeshardDriverExecutable:
             env[(v, -1)] = dict(slot)
 
         # zero accumulators (compiled once, reused every step)
-        if self._zero_exec_cache is None:
-            self._zero_exec_cache = []
-            by_mesh: Dict[int, List] = {}
-            for v, mesh_id, aval, sharding in self.acc_allocs:
-                by_mesh.setdefault(mesh_id, []).append((v, aval, sharding))
-            for mesh_id, items in by_mesh.items():
-                avals = [a for _, a, _ in items]
-                shardings = [s for _, _, s in items]
-                compiled = (jax.jit(
-                    lambda avs=tuple(avals): [
-                        jnp.zeros(a.shape, a.dtype) for a in avs
-                    ],
-                    out_shardings=shardings).lower().compile())
-                self._zero_exec_cache.append(
-                    (mesh_id, [v for v, _, _ in items], compiled))
+        self._ensure_zero_execs()
         for mesh_id, vs, compiled in self._zero_exec_cache:
             bufs = compiled()
             for v, buf in zip(vs, bufs):
@@ -747,6 +766,194 @@ class PipeshardDriverExecutable:
                     outs.append(vals[0])
                 elif vals[0].ndim >= 1:
                     # axis 0 must be the (microbatched) batch dim
+                    outs.append(jnp.concatenate(
+                        [jax.device_put(
+                            x, self.mesh_group[meshes[0][1]]
+                            .flat_devices[0]) for x in vals], axis=0))
+                else:
+                    raise ValueError(
+                        "A scalar output of a pipelined forward-only "
+                        "function is ambiguous with num_micro_batches > 1 "
+                        "(per-microbatch reduction cannot be recombined); "
+                        "return per-example values or use "
+                        "num_micro_batches=1.")
+        return outs
+
+    def _ensure_zero_execs(self):
+        """Compile (once) the per-mesh zero-accumulator allocators."""
+        if self._zero_exec_cache is not None:
+            return
+        self._zero_exec_cache = []
+        by_mesh: Dict[int, List] = {}
+        for v, mesh_id, aval, sharding in self.acc_allocs:
+            by_mesh.setdefault(mesh_id, []).append((v, aval, sharding))
+        for mesh_id, items in by_mesh.items():
+            avals = [a for _, a, _ in items]
+            shardings = [s for _, _, s in items]
+            compiled = (jax.jit(
+                lambda avs=tuple(avals): [
+                    jnp.zeros(a.shape, a.dtype) for a in avs
+                ],
+                out_shardings=shardings).lower().compile())
+            self._zero_exec_cache.append(
+                (mesh_id, [v for v, _, _ in items], compiled))
+
+    # ------------------------------------------------------------------
+    # register-file replay fast path (ISSUE 2)
+    # ------------------------------------------------------------------
+    def _ensure_lowered(self):
+        """Lower the instruction list into a RegisterFileProgram (once)
+        and precompute the launch-time slot tables: input loads, const
+        loads, accumulator slots, and output slots — so the replay loop
+        touches only integer-indexed lists."""
+        if self._register_program is not None:
+            return self._register_program
+        from alpa_tpu.pipeline_parallel.runtime_emitter import (
+            lower_to_register_file)
+        n_mb = self.num_micro_batches
+        ginvar_idx = {v: i for i, v in enumerate(self.global_invars)}
+
+        # static sharding seed: everything placed at launch
+        preplaced: Dict[Tuple[Var, int, int], Any] = {}
+        for v, places in self.input_place.items():
+            if self.batch_invars[ginvar_idx[v]]:
+                for mesh_id, sh in places:
+                    for mb in range(n_mb):
+                        preplaced[(v, mb, mesh_id)] = sh
+            else:
+                for mesh_id, sh in places:
+                    preplaced[(v, -1, mesh_id)] = sh
+        for v, places in self.const_place.items():
+            for mesh_id, sh in places:
+                preplaced[(v, -1, mesh_id)] = sh
+        for v, mesh_id, _aval, sh in self.acc_allocs:
+            preplaced[(v, -1, mesh_id)] = sh
+
+        prog = lower_to_register_file(self.instructions, preplaced)
+        slot_of = prog.slot_of
+
+        # input placement: (flat arg index, is_batch, [(slot, sharding,
+        # microbatch)]) — resolved once, replayed every launch
+        self._reg_input_loads = []
+        for v, places in self.input_place.items():
+            i = ginvar_idx[v]
+            entries = []
+            if self.batch_invars[i]:
+                for mesh_id, sh in places:
+                    for mb in range(n_mb):
+                        entries.append((slot_of[(v, mb, mesh_id)], sh, mb))
+            else:
+                for mesh_id, sh in places:
+                    entries.append((slot_of[(v, -1, mesh_id)], sh, -1))
+            self._reg_input_loads.append((i, self.batch_invars[i], entries))
+
+        # outputs: mirror output_specs with slots
+        out_specs = []
+        for kind, payload in self.output_specs:
+            if kind == "literal":
+                out_specs.append(("literal", payload))
+            elif kind == "env":
+                k, m = payload
+                out_specs.append(("slot", slot_of[(k[0], k[1], m)]))
+            elif kind == "input":
+                out_specs.append(("input", payload))
+            else:  # concat
+                v, meshes = payload
+                out_specs.append(
+                    ("concat", ([slot_of[(v, mb, m)] for mb, m in meshes],
+                                meshes)))
+        self._reg_output_specs = out_specs
+        self._register_program = prog
+        return prog
+
+    def _launch_registers(self, flat_args):
+        """Replay the lowered register-file program: flat list reads and
+        writes only — the per-instruction driver cost is the compiled
+        executables' C++ dispatch plus the pre-resolved transfers."""
+        prog = self._ensure_lowered()
+        regs: List[Any] = [None] * prog.num_slots
+        n_mb = self.num_micro_batches
+
+        # place global inputs in one batched device_put
+        put_vals, put_shs, put_slots = [], [], []
+        for arg_idx, is_batch, entries in self._reg_input_loads:
+            arg = flat_args[arg_idx]
+            if is_batch:
+                if n_mb == 1:
+                    mbs = [arg]
+                elif isinstance(arg, jax.Array):
+                    mbs = jnp.split(arg, n_mb, axis=0)
+                else:
+                    mbs = np.split(np.asarray(arg), n_mb, axis=0)
+                for s, sh, mb in entries:
+                    put_vals.append(mbs[mb])
+                    put_shs.append(sh)
+                    put_slots.append(s)
+            else:
+                for s, sh, _mb in entries:
+                    put_vals.append(arg)
+                    put_shs.append(sh)
+                    put_slots.append(s)
+        if put_vals:
+            placed = jax.device_put(put_vals, put_shs)
+            for s, o in zip(put_slots, placed):
+                regs[s] = o
+
+        # consts (placed once, re-slotted per launch)
+        if self._reg_const_loads is None:
+            slot_of = prog.slot_of
+            loads = []
+            for v, places in self.const_place.items():
+                val = self.consts_map[v]
+                for mesh_id, sh in places:
+                    loads.append((slot_of[(v, -1, mesh_id)],
+                                  jax.device_put(val, sh)))
+            self._reg_const_loads = loads
+        for s, a in self._reg_const_loads:
+            regs[s] = a
+
+        # zero accumulators (compiled once; slots resolved once)
+        self._ensure_zero_execs()
+        if self._reg_acc_slots is None:
+            slot_of = prog.slot_of
+            self._reg_acc_slots = [
+                (compiled, [slot_of[(v, -1, mesh_id)] for v in vs])
+                for mesh_id, vs, compiled in self._zero_exec_cache
+            ]
+        for compiled, slots in self._reg_acc_slots:
+            for s, buf in zip(slots, compiled()):
+                regs[s] = buf
+
+        # replay
+        loop_tic = time.perf_counter()
+        prog.execute(regs)
+        loop_s = time.perf_counter() - loop_tic
+        n_inst = max(1, prog.n_instructions)
+        self.last_dispatch_stats = {
+            "n_instructions": prog.n_instructions,
+            "n_ops": len(prog.ops),
+            "loop_s": loop_s,
+            "per_inst_us": loop_s / n_inst * 1e6,
+            "mode": "registers",
+            "by_opcode": {k: {"n": v, "s": 0.0}
+                          for k, v in prog.by_opcode.items()},
+        }
+
+        # collect outputs
+        outs = []
+        for kind, payload in self._reg_output_specs:
+            if kind == "literal":
+                outs.append(payload)
+            elif kind == "slot":
+                outs.append(regs[payload])
+            elif kind == "input":
+                outs.append(flat_args[payload])
+            else:  # concat over microbatches (inference outputs)
+                slots, meshes = payload
+                vals = [regs[s] for s in slots]
+                if n_mb == 1:
+                    outs.append(vals[0])
+                elif vals[0].ndim >= 1:
                     outs.append(jnp.concatenate(
                         [jax.device_put(
                             x, self.mesh_group[meshes[0][1]]
@@ -939,6 +1146,35 @@ class PipeshardDriverExecutable:
 
     def get_instruction_text(self) -> str:
         return "\n".join(repr(i) for i in self.instructions)
+
+    def get_plan_fingerprint(self) -> str:
+        """Content hash of the compiled parallel plan: instruction stream
+        plus every stage's input/output shardings.  Two executables with
+        equal fingerprints replay identically — used by the compile-cache
+        determinism tests (a plan loaded from the persistent cache must
+        reproduce a fresh solve exactly).
+
+        ``Var`` reprs embed trace-time object ids, so ids are renumbered
+        by first appearance — two independent traces of the same program
+        hash identically while distinct vars stay distinct."""
+        import hashlib
+        import re
+        parts = [self.get_instruction_text()]
+        for ex in self.stage_execs + [e for e in self.apply_execs
+                                      if e is not None]:
+            parts.append(ex.name)
+            parts.append(repr([str(s) for s in ex.in_shardings]))
+            parts.append(repr([str(s) for s in ex.out_shardings]))
+        text = "\n".join(parts)
+        renumber = {}
+
+        def canon(m):
+            return renumber.setdefault(m.group(0),
+                                       f"id={len(renumber)}")
+
+        text = re.sub(r"id=\d+", canon, text)
+        text = re.sub(r"0x[0-9a-fA-F]+", "0x0", text)
+        return hashlib.sha256(text.encode()).hexdigest()
 
     def dump_stage_execution_trace(self, filename: str):
         """Write the collected tracer events as a Chrome trace JSON

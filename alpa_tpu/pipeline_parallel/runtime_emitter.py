@@ -257,6 +257,221 @@ class DispatchRaceChecker:
                 "\n  ".join(self.violations[:10]))
 
 
+########################################
+# register-file lowering (replay fast path)
+########################################
+
+
+def _equiv_shardings(s1, s2, ndim) -> bool:
+    if s1 is None or s2 is None:
+        return True
+    try:
+        return s1.is_equivalent_to(s2, ndim)
+    except Exception:  # pylint: disable=broad-except
+        return s1 == s2
+
+
+@dataclasses.dataclass
+class RegisterFileProgram:
+    """The instruction list lowered to a flat register file (ISSUE 2).
+
+    Replay becomes ``for op in ops: op(regs)`` over ``regs = [None] *
+    num_slots``: every ``(var, microbatch, mesh)`` key was resolved to an
+    integer slot at build time, RUN inputs/outputs are precomputed index
+    tuples closed over each op, FREE is slot clears, and RESHARD carries a
+    pre-built :class:`~alpa_tpu.pipeline_parallel.cross_mesh_resharding.
+    DirectTransfer` executor (adjacent same-edge transfers coalesced into
+    one batched call) — no dict hashing, no sharding resolution, no
+    per-call planning on the hot path.
+    """
+    num_slots: int
+    ops: List[Any]                      # each: fn(regs) -> None
+    n_instructions: int                 # original instruction count
+    by_opcode: Dict[str, int]           # original counts per opcode
+    slot_of: Dict[Tuple[Var, int, int], int]
+    n_coalesced_groups: int
+    n_fixups: int
+    text: str                           # one line per op, for fingerprints
+
+    def execute(self, regs: List[Any]):
+        for op in self.ops:
+            op(regs)
+
+    def fingerprint(self) -> str:
+        import hashlib
+        return hashlib.sha256(self.text.encode()).hexdigest()
+
+
+def _make_run_op(compiled, in_slots, out_slots, fixups):
+    """RUN as a closure: gather args by slot index, call the compiled
+    fast path, scatter outputs.  ``fixups`` carries the (rare) arg
+    positions whose statically-tracked layout differs from the stage's
+    expected sharding — the register-file analog of the interpreter's
+    per-arg safety net, resolved at lowering instead of per call."""
+    if fixups:
+
+        def op(regs, _c=compiled, _i=in_slots, _o=out_slots, _f=fixups):
+            import jax
+            args = [regs[s] for s in _i]
+            for pos, sh, ndim in _f:
+                a = args[pos]
+                if not a.sharding.is_equivalent_to(sh, ndim):
+                    args[pos] = jax.device_put(a, sh)
+            outs = _c(*args)
+            for s, o in zip(_o, outs):
+                regs[s] = o
+    else:
+
+        def op(regs, _c=compiled, _i=in_slots, _o=out_slots):
+            outs = _c(*[regs[s] for s in _i])
+            for s, o in zip(_o, outs):
+                regs[s] = o
+
+    return op
+
+
+def _make_reshard_op(transfer, src_slot, dst_slot):
+    def op(regs, _t=transfer, _s=src_slot, _d=dst_slot):
+        regs[_d] = _t(regs[_s])
+
+    return op
+
+
+def _make_reshard_group_op(group, src_slots, dst_slots):
+    def op(regs, _g=group, _s=src_slots, _d=dst_slots):
+        outs = _g([regs[s] for s in _s])
+        for d, o in zip(_d, outs):
+            regs[d] = o
+
+    return op
+
+
+def _make_free_op(slots):
+    def op(regs, _s=slots):
+        for i in _s:
+            regs[i] = None
+
+    return op
+
+
+def lower_to_register_file(
+        instructions: List[PipelineInstruction],
+        preplaced_shardings: Dict[Tuple[Var, int, int], Any]
+) -> RegisterFileProgram:
+    """Lower the emitted instruction list into a :class:`RegisterFileProgram`.
+
+    ``preplaced_shardings`` seeds the static sharding model with the
+    launch-placed values (global inputs, consts, zero accumulators):
+    key ``(var, microbatch-instance, mesh)`` -> sharding.  The lowering
+    walks the instructions in global order tracking the layout each slot
+    holds, so RESHARD executors know their source sharding statically and
+    RUN args that would need the interpreter's per-call relayout safety
+    net become precomputed fixups.
+    """
+    from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+        DirectTransfer, DirectTransferGroup)
+
+    slot_of: Dict[Tuple[Var, int, int], int] = {}
+
+    def slot(key):
+        s = slot_of.get(key)
+        if s is None:
+            s = slot_of[key] = len(slot_of)
+        return s
+
+    cur_sharding: Dict[int, Any] = {}
+    for key, sh in preplaced_shardings.items():
+        cur_sharding[slot(key)] = sh
+
+    ops: List[Any] = []
+    lines: List[str] = []
+    by_opcode = {"RUN": 0, "RESHARD": 0, "FREE": 0}
+    n_groups = 0
+    n_fixups = 0
+
+    i = 0
+    n = len(instructions)
+    while i < n:
+        inst = instructions[i]
+        if inst.opcode == PipelineInstType.RUN:
+            by_opcode["RUN"] += 1
+            ex = inst.executable
+            in_slots, fixups = [], []
+            for pos, k in enumerate(inst.input_keys):
+                s = slot((k[0], k[1], inst.dst_mesh))
+                in_slots.append(s)
+                need = ex.in_shardings[pos]
+                ndim = len(getattr(ex.invars[pos].aval, "shape", ()))
+                if not _equiv_shardings(cur_sharding.get(s), need, ndim):
+                    fixups.append((pos, need, ndim))
+            out_slots = []
+            for pos, k in enumerate(inst.output_keys):
+                s = slot((k[0], k[1], inst.dst_mesh))
+                out_slots.append(s)
+                cur_sharding[s] = ex.out_shardings[pos]
+            n_fixups += len(fixups)
+            ops.append(
+                _make_run_op(ex.compiled, tuple(in_slots), tuple(out_slots),
+                             tuple(fixups)))
+            lines.append(f"RUN {inst.info} mb={inst.micro_batch} "
+                         f"in={in_slots} out={out_slots} "
+                         f"fix={[(p, str(s)) for p, s, _ in fixups]}")
+            i += 1
+        elif inst.opcode == PipelineInstType.RESHARD:
+            # coalesce the maximal run of globally-adjacent RESHARDs on
+            # the same (src, dst) edge into one batched transfer
+            edge = (inst.src_mesh, inst.dst_mesh)
+            j = i
+            group: List[PipelineInstruction] = []
+            while (j < n and
+                   instructions[j].opcode == PipelineInstType.RESHARD and
+                   (instructions[j].src_mesh,
+                    instructions[j].dst_mesh) == edge):
+                group.append(instructions[j])
+                j += 1
+            src_slots, dst_slots, transfers = [], [], []
+            for g in group:
+                by_opcode["RESHARD"] += 1
+                v = g.var_key[0]
+                ss = slot((v, g.var_key[1], g.src_mesh))
+                ds = slot((v, g.var_key[1], g.dst_mesh))
+                t = DirectTransfer(v.aval, cur_sharding.get(ss),
+                                   g.dst_sharding)
+                src_slots.append(ss)
+                dst_slots.append(ds)
+                transfers.append(t)
+                cur_sharding[ds] = g.dst_sharding
+                lines.append(f"RESHARD {g.var_key} {g.src_mesh}->"
+                             f"{g.dst_mesh} slot {ss}->{ds} "
+                             f"fast={t.fast} edgegroup={len(group)}")
+            if len(group) == 1:
+                ops.append(
+                    _make_reshard_op(transfers[0], src_slots[0],
+                                     dst_slots[0]))
+            else:
+                n_groups += 1
+                ops.append(
+                    _make_reshard_group_op(DirectTransferGroup(transfers),
+                                           tuple(src_slots),
+                                           tuple(dst_slots)))
+            i = j
+        else:  # FREE
+            by_opcode["FREE"] += 1
+            slots = tuple(slot((k[0], k[1], k[2])) for k in inst.free_keys)
+            ops.append(_make_free_op(slots))
+            lines.append(f"FREE {list(slots)}")
+            i += 1
+
+    return RegisterFileProgram(num_slots=len(slot_of),
+                               ops=ops,
+                               n_instructions=n,
+                               by_opcode=by_opcode,
+                               slot_of=slot_of,
+                               n_coalesced_groups=n_groups,
+                               n_fixups=n_fixups,
+                               text="\n".join(lines))
+
+
 def emit_free_instructions(instructions: List[PipelineInstruction],
                            protected_keys) -> List[PipelineInstruction]:
     """Insert FREE after the last use of each (var, inst, mesh) value

@@ -188,11 +188,62 @@ def cluster_layers_and_slice_mesh(
         return fwd_ids, submeshes, logical_shapes, as_dicts
 
     if isinstance(stage_option, AutoStageOption):
+        from alpa_tpu.compile_cache import cache_enabled, get_compile_cache
         from alpa_tpu.pipeline_parallel.stage_dp import auto_stage_dp
-        return auto_stage_dp(num_forward_layers, virtual_mesh, stage_option,
-                             layer_flops, layer_comps, num_micro_batches,
-                             auto_sharding_option, objective=objective,
-                             schedule=schedule)
+
+        # The DP decision is a pure function of the layer jaxprs, the
+        # cluster extent, and the options — replay it from the compile
+        # cache (submeshes are re-sliced from the live virtual mesh; only
+        # their shapes are persisted).
+        cache = key = None
+        if cache_enabled():
+            cache = get_compile_cache()
+            comp_texts = [str(c.closed_jaxpr() if hasattr(c, "closed_jaxpr")
+                              else c) for c in (layer_comps or [])]
+            key = cache.make_key("stage_dp", [
+                "cluster_layers_and_slice_mesh",
+                repr(num_forward_layers),
+                repr((virtual_mesh.num_hosts,
+                      virtual_mesh.num_devices_per_host)),
+                stage_option,
+                repr(list(layer_flops) if layer_flops is not None else None),
+                repr(num_micro_batches),
+                auto_sharding_option if auto_sharding_option is not None
+                else "no-as-option",
+                objective,
+                schedule,
+            ] + comp_texts)
+            entry = cache.get("stage_dp", key)
+            if entry is not None:
+                try:
+                    submeshes = get_sliced_virtual_submeshes(
+                        virtual_mesh, entry["phys_shapes"])
+                    cache.record_saved_seconds(
+                        "stage_dp", entry.get("solve_seconds", 0.0))
+                    return (entry["fwd_ids"], submeshes,
+                            entry["logical_shapes"], entry["as_dicts"])
+                except Exception:  # pylint: disable=broad-except
+                    logger.warning("cached stage-DP decision failed to "
+                                   "replay; re-solving", exc_info=True)
+
+        import time
+        tic = time.time()
+        fwd_ids, submeshes, logical_shapes, as_dicts = auto_stage_dp(
+            num_forward_layers, virtual_mesh, stage_option,
+            layer_flops, layer_comps, num_micro_batches,
+            auto_sharding_option, objective=objective, schedule=schedule)
+        if cache is not None and key is not None:
+            solve_seconds = time.time() - tic
+            cache.record_solve_seconds("stage_dp", solve_seconds)
+            cache.put("stage_dp", key, {
+                "fwd_ids": [list(s) for s in fwd_ids],
+                "phys_shapes": [(sub.num_hosts, sub.num_devices_per_host)
+                                for sub in submeshes],
+                "logical_shapes": list(logical_shapes),
+                "as_dicts": list(as_dicts),
+                "solve_seconds": solve_seconds,
+            })
+        return fwd_ids, submeshes, logical_shapes, as_dicts
 
     # Uniform: num_stages = num_hosts (or all devices as equal slices)
     num_stages = (stage_option.num_stages if isinstance(

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding
 
+from alpa_tpu.compile_cache import cache_enabled, get_compile_cache
 from alpa_tpu.device_mesh import PhysicalDeviceMesh
 from alpa_tpu.global_env import global_config
 from alpa_tpu.shard_parallel.auto_sharding import (AutoShardingOption,
@@ -66,6 +67,36 @@ def plan_auto_sharding(fun: Callable,
     used by fidelity tests comparing the ILP solution to compiled HLO."""
     closed_jaxpr = jax.make_jaxpr(fun)(*in_avals)
 
+    # The winning (shape, choice) is a pure function of the jaxpr, the
+    # physical mesh extent, and the option — replay it from the compile
+    # cache instead of re-running the ILP over every candidate shape.
+    # ``return_graph`` callers are fidelity tests validating the solver
+    # itself, so they always solve fresh.
+    cache = key = None
+    if not return_graph and cache_enabled():
+        cache = get_compile_cache()
+        key = cache.make_key("ilp", [
+            "plan_auto_sharding",
+            str(closed_jaxpr),
+            repr([str(a) for a in in_avals]),
+            repr(tuple(batch_flat_idx)),
+            repr((physical_mesh.num_hosts, physical_mesh.num_devices)),
+            option,
+        ])
+        entry = cache.get("ilp", key)
+        if entry is not None:
+            replayed = _replay_cached_solution(
+                closed_jaxpr, in_avals, batch_flat_idx, physical_mesh,
+                option, entry)
+            if replayed is not None:
+                cache.record_saved_seconds(
+                    "ilp", entry.get("solve_seconds", 0.0))
+                shape, logical_mesh, graph, choice = replayed
+                return _assemble_plan(closed_jaxpr, in_avals, in_paths,
+                                      batch_flat_idx, option, shape,
+                                      logical_mesh, graph, choice,
+                                      return_graph)
+
     best = None
     tic = time.time()
     infeasible = None
@@ -92,10 +123,55 @@ def plan_auto_sharding(fun: Callable,
     if best is None:
         raise infeasible
     cost, shape, logical_mesh, graph, choice = best
+    solve_seconds = time.time() - tic
     if global_config.print_compilation_time:
         logger.warning("auto-sharding search took %.2f s; picked %s "
-                       "(cost %.4f)", time.time() - tic, shape, cost)
+                       "(cost %.4f)", solve_seconds, shape, cost)
+    if cache is not None and key is not None:
+        cache.record_solve_seconds("ilp", solve_seconds)
+        cache.put("ilp", key, {
+            "shape": tuple(shape),
+            "choice": [int(s) for s in choice],
+            "cost": float(cost),
+            "solve_seconds": solve_seconds,
+        })
 
+    return _assemble_plan(closed_jaxpr, in_avals, in_paths, batch_flat_idx,
+                          option, shape, logical_mesh, graph, choice,
+                          return_graph)
+
+
+def _replay_cached_solution(closed_jaxpr, in_avals, batch_flat_idx,
+                            physical_mesh, option, entry):
+    """Rebuild (shape, logical_mesh, graph, choice) from a cached ILP
+    solution, or None if the entry no longer fits the strategy graph
+    (e.g. strategy enumeration changed without a format-version bump)."""
+    try:
+        shape = tuple(entry["shape"])
+        choice = entry["choice"]
+        if shape not in candidate_mesh_shapes(physical_mesh.num_devices,
+                                              option,
+                                              physical_mesh.num_hosts == 1):
+            return None
+        logical_mesh = physical_mesh.get_logical_mesh(shape)
+        graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
+                                     batch_flat_idx, option)
+        if len(choice) != len(graph.nodes):
+            return None
+        for node, s in zip(graph.nodes, choice):
+            if not 0 <= s < len(node.strategies):
+                return None
+    except Exception:  # pylint: disable=broad-except
+        logger.warning("cached ILP solution failed to replay; re-solving",
+                       exc_info=True)
+        return None
+    return shape, logical_mesh, graph, choice
+
+
+def _assemble_plan(closed_jaxpr, in_avals, in_paths, batch_flat_idx, option,
+                   shape, logical_mesh, graph, choice, return_graph):
+    """Turn a solved (graph, choice) into the plan_auto_sharding result
+    tuple.  Shared by the fresh-solve path and the cache-replay path."""
     axis_names = MESH_AXIS_NAMES[:len(shape)]
     jax_mesh = logical_mesh.get_jax_mesh(axis_names)
 

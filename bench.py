@@ -127,6 +127,30 @@ def _run_inner(timeout):
     return None, f"rc={r.returncode}: {(r.stderr or '')[-800:]}"
 
 
+def _cpu_dispatch_us():
+    """Per-instruction driver dispatch latency (us) measured on an
+    8-device CPU mesh in a subprocess, or None if the measurement fails.
+    Run when the TPU is wedged: dispatch is a pure-driver cost, so the
+    CPU number is still meaningful (see benchmark/bench_dispatch.py)."""
+    code = (
+        "from alpa_tpu.platform import pin_cpu_platform;"
+        "pin_cpu_platform(8);"
+        "from scripts.dispatch_overhead_bench import measure;"
+        "import json;"
+        "print(json.dumps(measure(n_steps=3, dispatch_mode='registers')))")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=600, capture_output=True,
+            text=True, cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        for line in (r.stdout or "").splitlines():
+            if line.startswith("{"):
+                return round(json.loads(line)["per_inst_us"], 2)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return None
+
+
 def _run_with_recovery(total_budget):
     t0 = time.time()
     probes = []
@@ -168,6 +192,10 @@ def _run_with_recovery(total_budget):
             "error": ("bench child kept failing"
                       if child_errors and child_errors[-1] != "timeout"
                       else "device unresponsive for the whole bench window"),
+            # the TPU is wedged but the driver isn't: report the CPU-mesh
+            # register-dispatch latency so the run still carries a
+            # dispatch-path datapoint (ISSUE 2)
+            "cpu_dispatch_us": _cpu_dispatch_us(),
             "probe_history": ["ok" if p else "wedged" for p in probes],
             "child_errors": child_errors[-3:],
             "last_good_onchip": "76.06 TFLOPS/chip (vs_baseline 2.055, "

@@ -1,0 +1,114 @@
+"""Dispatch fast-path microbenchmark: interpreter vs register file (ISSUE 2).
+
+Same near-zero-FLOP payload as scripts/dispatch_overhead_bench.py (MLP
+hidden dim 8, 8 stages on 8 single-device CPU meshes, 2 microbatches:
+wall time is driver dispatch, not compute), run once per dispatch mode:
+
+* ``sequential`` — the per-instruction interpreter (dict-keyed buffers,
+  sharding resolution per RESHARD).
+* ``threaded`` — the per-mesh-stream interpreter (the mode the committed
+  dispatch_overhead.json artifact was measured in).
+* ``registers`` — the build-time register-file lowering (flat slot
+  buffers, precomputed index tuples, cached resharding executors).
+
+Writes ``benchmark/results/dispatch_modes.json`` with per-mode
+per-instruction latency and the speedup of the register path over both
+live interpreter runs and the committed 160.8 us/inst artifact baseline.
+
+Usage::
+
+    python benchmark/bench_dispatch.py [--steps N] [--out FILE]
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# per_inst_us of the committed threaded-mode artifact
+# (benchmark/results/dispatch_overhead.json); the ISSUE 2 acceptance bar
+# is >= 5x reduction vs this number.
+ARTIFACT_BASELINE_US = 160.8
+
+MODES = ("sequential", "threaded", "registers")
+
+
+def run_modes(n_steps: int = 8):
+    import alpa_tpu
+    from alpa_tpu import PipeshardParallel
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    alpa_tpu.init(cluster="local")
+
+    results = {}
+    for mode in MODES:
+        global_config.pipeline_dispatch_mode = mode
+        # fresh method + state per mode: TrainState args are donated, and
+        # each executable must lower under the mode being measured
+        method = PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=8),
+            stage_option=UniformStageOption(num_stages=8))
+        step = get_mlp_train_step(method, use_value_and_grad=True)
+        state, batch = create_mlp_train_state_and_batch(
+            batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+            num_layers=8)
+
+        state, loss = step(state, batch)   # compile + lower
+        float(loss)
+        ex = step.get_last_executable()
+
+        best = None
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            float(loss)                    # drain before reading stats
+            st = dict(ex.last_dispatch_stats)
+            if best is None or st["per_inst_us"] < best["per_inst_us"]:
+                best = st
+        assert best["mode"] == mode, (
+            f"requested {mode!r}, executed {best['mode']!r}")
+        results[mode] = best
+    global_config.pipeline_dispatch_mode = "auto"
+
+    reg = results["registers"]["per_inst_us"]
+    return {
+        "payload": "mlp h8 x 8 layers, bs8, 2 microbatches on 8 "
+                   "single-device CPU meshes (near-zero FLOPs: wall time "
+                   "is driver dispatch, not compute)",
+        "n_instructions": results["registers"]["n_instructions"],
+        "modes": results,
+        "artifact_baseline_us": ARTIFACT_BASELINE_US,
+        "speedup_vs_sequential":
+            results["sequential"]["per_inst_us"] / reg,
+        "speedup_vs_threaded":
+            results["threaded"]["per_inst_us"] / reg,
+        "speedup_vs_artifact": ARTIFACT_BASELINE_US / reg,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--steps", type=int, default=8,
+                        help="timed steps per mode (best-of is reported)")
+    parser.add_argument("--out", default=os.path.join(
+        REPO, "benchmark", "results", "dispatch_modes.json"))
+    args = parser.parse_args()
+
+    from alpa_tpu.platform import pin_cpu_platform
+    pin_cpu_platform(8)
+    report = run_modes(args.steps)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""Inspect / clear / summarize the persistent compile cache (ISSUE 2).
+
+The cache directory comes from ``--dir``, else ``ALPA_TPU_CACHE_DIR``.
+
+Usage::
+
+    python scripts/cache_tool.py inspect [--dir DIR] [--namespace NS]
+    python scripts/cache_tool.py clear   [--dir DIR] [--namespace NS]
+    python scripts/cache_tool.py stat    [--dir DIR]
+
+``inspect`` lists every disk entry (namespace, key prefix, size, age);
+``clear`` removes entries (optionally one namespace: ilp / stage_dp /
+parallel_plan); ``stat`` prints totals per namespace.
+"""
+import argparse
+import collections
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.compile_cache import CompileCache  # noqa: E402
+
+
+def _cache_from(args) -> CompileCache:
+    cache_dir = args.dir or os.environ.get("ALPA_TPU_CACHE_DIR")
+    if not cache_dir:
+        sys.exit("no cache dir: pass --dir or set ALPA_TPU_CACHE_DIR")
+    return CompileCache(cache_dir=cache_dir)
+
+
+def _age(mtime: float) -> str:
+    s = time.time() - mtime
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if s >= div:
+            return f"{s / div:.1f}{unit}"
+    return f"{s:.0f}s"
+
+
+def cmd_inspect(args):
+    cache = _cache_from(args)
+    entries = [e for e in cache.entries()
+               if args.namespace in (None, e["namespace"])]
+    if not entries:
+        print(f"no entries in {cache.cache_dir}")
+        return
+    print(f"{'namespace':<14} {'key':<18} {'bytes':>9} {'age':>7}")
+    for e in entries:
+        print(f"{e['namespace']:<14} {e['key'][:16] + '..':<18} "
+              f"{e['bytes']:>9} {_age(e['mtime']):>7}")
+    print(f"{len(entries)} entries, "
+          f"{sum(e['bytes'] for e in entries)} bytes total")
+
+
+def cmd_clear(args):
+    cache = _cache_from(args)
+    removed = cache.clear(namespace=args.namespace)
+    what = args.namespace or "all namespaces"
+    print(f"removed {removed} disk entries ({what}) from {cache.cache_dir}")
+
+
+def cmd_stat(args):
+    cache = _cache_from(args)
+    per_ns = collections.defaultdict(lambda: [0, 0])
+    for e in cache.entries():
+        per_ns[e["namespace"]][0] += 1
+        per_ns[e["namespace"]][1] += e["bytes"]
+    print(f"cache dir: {cache.cache_dir}")
+    if not per_ns:
+        print("  (empty)")
+    for ns, (n, nbytes) in sorted(per_ns.items()):
+        print(f"  {ns:<14} {n:>5} entries  {nbytes:>10} bytes")
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    for name, fn in (("inspect", cmd_inspect), ("clear", cmd_clear),
+                     ("stat", cmd_stat)):
+        p = sub.add_parser(name)
+        p.add_argument("--dir", default=None,
+                       help="cache directory (default: $ALPA_TPU_CACHE_DIR)")
+        if name != "stat":
+            p.add_argument("--namespace", default=None,
+                           choices=["ilp", "stage_dp", "parallel_plan"])
+        p.set_defaults(fn=fn)
+    args = parser.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,9 +4,11 @@ The round-4 artifact (dispatch_latency.json, per_inst_us ~9.6ms) timed
 RUN *compute*, not dispatch: its payloads were real training matmuls.
 Here the payloads are near-zero-FLOP (hidden dim 8), so the instruction
 loop's wall time IS the driver cost — Python stream interpretation +
-jitted-call enqueue — measured in the threaded per-mesh-stream mode at
-8 single-device meshes.  On an async backend RUN returns at enqueue, so
-per-instruction wall time bounds per-tick dispatch.
+jitted-call enqueue — at 8 single-device meshes.  On an async backend
+RUN returns at enqueue, so per-instruction wall time bounds per-tick
+dispatch.  Since ISSUE 2 the default ("auto") mode replays the register
+-file lowering; pass ``dispatch_mode`` to measure a specific mode, or
+use benchmark/bench_dispatch.py for the full mode comparison.
 
 Writes benchmark/results/dispatch_overhead.json; the sub-ms assertion
 lives in tests/runtime/test_dispatch_overhead.py.
@@ -19,9 +21,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
-def measure(n_steps=10):
+def measure(n_steps=10, dispatch_mode=None):
     import alpa_tpu
     from alpa_tpu import PipeshardParallel
+    from alpa_tpu.global_env import global_config
     from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
     from alpa_tpu.pipeline_parallel.stage_construction import (
         UniformStageOption)
@@ -29,30 +32,36 @@ def measure(n_steps=10):
                                   get_mlp_train_step)
 
     alpa_tpu.init(cluster="local")
-    state, batch = create_mlp_train_state_and_batch(
-        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
-        num_layers=8)
-    method = PipeshardParallel(
-        num_micro_batches=2,
-        layer_option=AutoLayerOption(layer_num=8),
-        stage_option=UniformStageOption(num_stages=8))
-    step = get_mlp_train_step(method, use_value_and_grad=True)
+    prev_mode = global_config.pipeline_dispatch_mode
+    if dispatch_mode is not None:
+        global_config.pipeline_dispatch_mode = dispatch_mode
+    try:
+        state, batch = create_mlp_train_state_and_batch(
+            batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+            num_layers=8)
+        method = PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=8),
+            stage_option=UniformStageOption(num_stages=8))
+        step = get_mlp_train_step(method, use_value_and_grad=True)
 
-    state, loss = step(state, batch)       # compile
-    float(loss)
-    ex = step.get_last_executable()
+        state, loss = step(state, batch)       # compile
+        float(loss)
+        ex = step.get_last_executable()
 
-    best = None
-    for _ in range(n_steps):
-        state, loss = step(state, batch)
-        float(loss)                        # drain before reading stats
-        st = dict(ex.last_dispatch_stats)
-        if best is None or st["per_inst_us"] < best["per_inst_us"]:
-            best = st
-    best["n_meshes"] = ex.num_meshes
-    best["payload"] = "mlp h8 x 8 layers, bs8, 2 microbatches (near-zero "\
-        "FLOPs: wall time is driver dispatch, not compute)"
-    return best
+        best = None
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            float(loss)                        # drain before reading stats
+            st = dict(ex.last_dispatch_stats)
+            if best is None or st["per_inst_us"] < best["per_inst_us"]:
+                best = st
+        best["n_meshes"] = ex.num_meshes
+        best["payload"] = "mlp h8 x 8 layers, bs8, 2 microbatches "\
+            "(near-zero FLOPs: wall time is driver dispatch, not compute)"
+        return best
+    finally:
+        global_config.pipeline_dispatch_mode = prev_mode
 
 
 def main():

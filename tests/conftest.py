@@ -22,3 +22,20 @@ import alpa_tpu  # noqa: E402
 def reset_cluster_state():
     yield
     alpa_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def isolated_compile_cache():
+    """Each test gets a fresh, memory-only compile cache: no cross-test
+    hit/miss bleed, and a developer's ALPA_TPU_CACHE_DIR never leaks
+    persisted solver decisions into (or out of) the test run.  Tests that
+    want a disk tier point ``global_config.compile_cache_dir`` at a
+    tmp_path and call ``reset_compile_cache()`` themselves."""
+    from alpa_tpu.compile_cache import reset_compile_cache
+    from alpa_tpu.global_env import global_config
+    prev_dir = global_config.compile_cache_dir
+    global_config.compile_cache_dir = None
+    reset_compile_cache()
+    yield
+    global_config.compile_cache_dir = prev_dir
+    reset_compile_cache()

@@ -1,0 +1,151 @@
+"""Persistent compile cache (ISSUE 2): unit behavior of the two-tier
+store plus end-to-end replay through the pipeshard compile path.
+
+The end-to-end oracle is twofold: (1) hit counters — a second compile in
+the same process hits the memory tier, and a simulated restart (fresh
+CompileCache object over the same directory) hits the disk tier with
+zero new ILP solves; (2) determinism — a plan replayed from the cache
+produces an executable whose plan fingerprint (instruction stream +
+per-stage shardings) is identical to the fresh solve's.
+"""
+import os
+
+import pytest
+
+import alpa_tpu
+from alpa_tpu import PipeshardParallel
+from alpa_tpu.compile_cache import (CompileCache, fingerprint_parts,
+                                    get_compile_cache, reset_compile_cache)
+from alpa_tpu.global_env import global_config
+from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+from alpa_tpu.pipeline_parallel.stage_construction import AutoStageOption
+from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+class TestCompileCacheUnit:
+
+    def test_fingerprint_stable_and_discriminating(self):
+        assert fingerprint_parts(["a", "b"]) == fingerprint_parts(["a", "b"])
+        assert fingerprint_parts(["a", "b"]) != fingerprint_parts(["ab"])
+        assert fingerprint_parts(["a"]) != fingerprint_parts(["b"])
+
+    def test_fingerprint_masks_addresses(self):
+        # str(jaxpr) embeds live function addresses; the same program must
+        # fingerprint identically across traces
+        a = "jvp_jaxpr_thunk=<function memoized at 0x7fb0765e3d90>"
+        b = "jvp_jaxpr_thunk=<function memoized at 0x7fb0765257e0>"
+        assert fingerprint_parts([a]) == fingerprint_parts([b])
+
+    def test_memory_tier_lru(self):
+        cache = CompileCache(cache_dir=None, memory_entries=2)
+        for i in range(3):
+            cache.put("ilp", f"ilp-k{i}", i)
+        assert cache.get("ilp", "ilp-k0") is None  # evicted
+        assert cache.get("ilp", "ilp-k2") == 2
+        s = cache.stats()["namespaces"]["ilp"]
+        assert s["puts"] == 3 and s["hits"] == 1 and s["misses"] == 1
+
+    def test_disk_tier_roundtrip_and_promotion(self, tmp_path):
+        d = str(tmp_path)
+        CompileCache(cache_dir=d).put("ilp", "ilp-key", {"x": 1})
+        # fresh object = simulated restart; first get is a disk hit
+        cache2 = CompileCache(cache_dir=d)
+        assert cache2.get("ilp", "ilp-key") == {"x": 1}
+        s = cache2.stats()["namespaces"]["ilp"]
+        assert s["disk_hits"] == 1
+        # promoted: second get hits memory (disk_hits stays 1)
+        assert cache2.get("ilp", "ilp-key") == {"x": 1}
+        assert cache2.stats()["namespaces"]["ilp"]["disk_hits"] == 1
+
+    def test_corrupt_disk_entry_is_a_miss(self, tmp_path):
+        d = str(tmp_path)
+        cache = CompileCache(cache_dir=d)
+        cache.put("ilp", "ilp-bad", {"x": 1})
+        path = os.path.join(d, "ilp-bad.pkl")
+        with open(path, "wb") as f:
+            f.write(b"not a pickle")
+        fresh = CompileCache(cache_dir=d)
+        assert fresh.get("ilp", "ilp-bad") is None
+        assert not os.path.exists(path)  # dropped, not retried forever
+
+    def test_clear_by_namespace(self, tmp_path):
+        cache = CompileCache(cache_dir=str(tmp_path))
+        cache.put("ilp", "ilp-a", 1)
+        cache.put("stage_dp", "stage_dp-b", 2)
+        assert cache.clear(namespace="ilp") == 1
+        assert cache.get("ilp", "ilp-a") is None
+        assert cache.get("stage_dp", "stage_dp-b") == 2
+
+
+def _compile_pipeshard():
+    method = PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=AutoLayerOption(layer_num=4),
+        stage_option=AutoStageOption())
+    step = get_mlp_train_step(method, use_value_and_grad=False)
+    state, batch = create_mlp_train_state_and_batch(
+        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+        num_layers=4, manual_pipeline_layer=False)
+    step(state, batch)
+    return step.get_last_executable()
+
+
+class TestCompileCacheEndToEnd:
+
+    def test_warm_compile_hits_and_replays_deterministically(self, tmp_path):
+        from alpa_tpu.api import clear_executable_cache
+        global_config.compile_cache_dir = str(tmp_path)
+        reset_compile_cache()
+        alpa_tpu.init("local")
+
+        ex1 = _compile_pipeshard()
+        s1 = get_compile_cache().stats()["namespaces"]
+        assert s1["ilp"]["misses"] > 0 and s1["ilp"]["hits"] == 0
+        assert s1["ilp"]["puts"] == s1["ilp"]["misses"]
+        assert s1["stage_dp"]["puts"] == 1
+        assert s1["parallel_plan"]["puts"] == 1
+
+        # second compile in the same process: every solve replays
+        clear_executable_cache()
+        ex2 = _compile_pipeshard()
+        s2 = get_compile_cache().stats()["namespaces"]
+        assert s2["ilp"]["hits"] == s1["ilp"]["misses"]
+        assert s2["ilp"]["misses"] == s1["ilp"]["misses"]  # no new solves
+        assert s2["stage_dp"]["hits"] == 1
+        assert ex2.get_plan_fingerprint() == ex1.get_plan_fingerprint()
+
+        # simulated restart: fresh cache object over the same directory —
+        # all hits must come from disk, zero ILP/stage-DP solves
+        clear_executable_cache()
+        reset_compile_cache(CompileCache(cache_dir=str(tmp_path)))
+        ex3 = _compile_pipeshard()
+        s3 = get_compile_cache().stats()["namespaces"]
+        assert s3["ilp"]["misses"] == 0, "restart re-ran the ILP"
+        assert s3["ilp"]["disk_hits"] > 0
+        assert s3["stage_dp"]["misses"] == 0
+        assert s3["stage_dp"]["disk_hits"] == 1
+        assert ex3.get_plan_fingerprint() == ex1.get_plan_fingerprint()
+
+    def test_cache_disabled_never_stores(self):
+        alpa_tpu.init("local")
+        prev = global_config.compile_cache_enabled
+        global_config.compile_cache_enabled = False
+        try:
+            _compile_pipeshard()
+            assert get_compile_cache().stats()["namespaces"] == {}
+        finally:
+            global_config.compile_cache_enabled = prev
+
+    def test_monitoring_report(self, tmp_path):
+        from alpa_tpu.monitoring import (format_compile_cache_report,
+                                         get_compile_cache_stats)
+        global_config.compile_cache_dir = str(tmp_path)
+        reset_compile_cache()
+        alpa_tpu.init("local")
+        _compile_pipeshard()
+        stats = get_compile_cache_stats()
+        assert set(stats["namespaces"]) >= {"ilp", "stage_dp",
+                                            "parallel_plan"}
+        report = format_compile_cache_report()
+        assert "ilp" in report and str(tmp_path) in report

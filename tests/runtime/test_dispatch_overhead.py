@@ -1,9 +1,12 @@
 """Per-tick driver dispatch stays sub-millisecond at 8 meshes
 (SURVEY §7 hard part #5; VERDICT r4 next #7).
 
-Near-zero-FLOP payloads make the threaded instruction loop's wall time
-the driver cost itself — see scripts/dispatch_overhead_bench.py, which
-records the committed artifact with the same measurement.
+Near-zero-FLOP payloads make the instruction loop's wall time the driver
+cost itself — see scripts/dispatch_overhead_bench.py, which records the
+committed artifact with the same measurement.  Since ISSUE 2 the default
+mode ("auto") replays the build-time register-file lowering, so the
+measured mode is "registers"; the interpreter bound is kept as a
+regression guard via an explicit mode override.
 """
 import os
 import sys
@@ -17,6 +20,20 @@ def test_dispatch_under_1ms_per_instruction_at_8_meshes():
     from scripts.dispatch_overhead_bench import measure
 
     stats = measure(n_steps=5)
-    assert stats["mode"] == "threaded"
+    assert stats["mode"] == "registers"
     assert stats["n_meshes"] == 8
     assert stats["per_inst_us"] < 1000, stats
+
+
+def test_register_dispatch_beats_interpreter():
+    """The register fast path must stay ahead of the sequential
+    interpreter on the same payload (ISSUE 2 tentpole)."""
+    from scripts.dispatch_overhead_bench import measure
+
+    reg = measure(n_steps=5, dispatch_mode="registers")
+    seq = measure(n_steps=5, dispatch_mode="sequential")
+    assert reg["mode"] == "registers"
+    assert seq["mode"] == "sequential"
+    # generous bound: steady-state is ~3x; CI noise should never push a
+    # genuinely faster path past parity
+    assert reg["per_inst_us"] < seq["per_inst_us"], (reg, seq)
