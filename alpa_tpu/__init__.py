@@ -45,6 +45,8 @@ from alpa_tpu.pipeline_parallel.stage_construction import (AutoStageOption,
                                                            UniformStageOption)
 from alpa_tpu import fault
 from alpa_tpu.serialization import (restore_checkpoint, save_checkpoint)
+from alpa_tpu.checkpoint import (CheckpointManager, RecoveryCheckpointer,
+                                 RetentionPolicy)
 from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
 from alpa_tpu.shard_parallel.manual_sharding import ManualShardingOption
 from alpa_tpu.timer import timers, tracer

@@ -1,0 +1,35 @@
+"""Checkpoint 2.0: async distributed checkpointing (SURVEY §0 production
+persistence; supersedes raw ``alpa_tpu.serialization`` use).
+
+Modules:
+  * :mod:`~alpa_tpu.checkpoint.store` — content-addressed chunked shard
+    store: sha256-named chunks + one manifest per step carrying each
+    leaf's shape/dtype/index-map and chunk hashes.  The manifest commit
+    is atomic and LAST, so a ``kill -9`` mid-save can never produce a
+    "complete" but corrupt step.
+  * :mod:`~alpa_tpu.checkpoint.manager` — :class:`CheckpointManager`
+    with async double-buffered device→host staging (step N+1 overlaps
+    the disk write of step N), save-failure surfacing, plan-fingerprint
+    validation on resume, and retention GC.
+  * :mod:`~alpa_tpu.checkpoint.policy` — retention policies
+    (keep-last-K + keep-every-N).
+  * :mod:`~alpa_tpu.checkpoint.hot_swap` — zero-downtime serving weight
+    swap: stage + hash-verify new weights in the background, then swap
+    each replica under a drain barrier.
+
+See docs/checkpointing.md for the on-disk layout and walkthroughs.
+"""
+from alpa_tpu.checkpoint.manager import (CheckpointManager,
+                                         PlanFingerprintMismatch,
+                                         RecoveryCheckpointer)
+from alpa_tpu.checkpoint.policy import RetentionPolicy
+from alpa_tpu.checkpoint.store import (ChunkCorruptionError,
+                                       CheckpointNotFoundError,
+                                       ShardStore)
+from alpa_tpu.checkpoint.hot_swap import stage_weights_from_checkpoint
+
+__all__ = [
+    "CheckpointManager", "RecoveryCheckpointer", "PlanFingerprintMismatch",
+    "RetentionPolicy", "ShardStore", "ChunkCorruptionError",
+    "CheckpointNotFoundError", "stage_weights_from_checkpoint",
+]

@@ -1,0 +1,97 @@
+"""Zero-downtime serving weight swap.
+
+Split into two phases so the serving hot path never waits on disk:
+
+1. **Stage** (:func:`stage_weights_from_checkpoint`) — read a step from
+   the content-addressed store, verifying every chunk hash, and place
+   the new weights onto the SAME devices/shardings the replica's current
+   params occupy.  Runs entirely in the background: requests keep
+   flowing on the old weights.
+2. **Swap** (``serve.controller._Replica.swap_weights``) — a drain
+   barrier: the replica's batcher finishes its in-flight batch on the
+   old weights, queued requests wait (they are never dropped), the
+   params pointer + prefix KV swap, and the queue resumes on the new
+   weights.  The streaming engine is drained and rebuilt lazily; a
+   stream that outlives the drain window continues without error and
+   finishes its remaining tokens on the new weights.
+
+``POST /admin/reload`` on the serving controller drives both phases.
+"""
+import logging
+import time
+from typing import Any, Optional, Tuple
+
+from alpa_tpu.checkpoint import metrics
+from alpa_tpu.checkpoint.manager import CheckpointManager
+
+logger = logging.getLogger(__name__)
+
+
+def _as_manager(source) -> CheckpointManager:
+    if isinstance(source, CheckpointManager):
+        return source
+    from alpa_tpu.checkpoint.store import ShardStore
+    if isinstance(source, ShardStore):
+        mgr = CheckpointManager(source.root)
+        mgr.store = source
+        return mgr
+    return CheckpointManager(str(source))
+
+
+def _shardings_like(params):
+    """Pytree of shardings mirroring ``params``: device arrays keep
+    their exact placement; host leaves restore host-side."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x.sharding if isinstance(x, jax.Array) else None,
+        params)
+
+
+def stage_weights_from_checkpoint(source,
+                                  target_params: Any,
+                                  step: Optional[int] = None,
+                                  verify: bool = True,
+                                  expected_plan_fingerprint:
+                                  Optional[str] = None) -> Tuple[Any, int]:
+    """Background staging phase: load ``step`` (default latest) from
+    ``source`` (a CheckpointManager, ShardStore, or store path) into a
+    fresh pytree with ``target_params``'s structure and device
+    placement.  Every chunk read is hash-verified (``verify=True``), so
+    a truncated or bit-rotted checkpoint fails HERE — before any
+    replica is touched.  Returns ``(new_params, step_loaded)``."""
+    t0 = time.monotonic()
+    mgr = _as_manager(source)
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            from alpa_tpu.checkpoint.store import CheckpointNotFoundError
+            raise CheckpointNotFoundError(
+                f"no committed checkpoint steps in {mgr.store.root}")
+    new_params = mgr.restore(
+        target_params, step=step, shardings=_shardings_like(target_params),
+        expected_plan_fingerprint=expected_plan_fingerprint,
+        verify=verify)
+    staged = time.monotonic() - t0
+    metrics.incr("hot_swap_staged")
+    metrics.incr("hot_swap_stage_seconds", staged)
+    logger.info("staged weights from step %d in %.3fs (hash-verified)",
+                step, staged)
+    return new_params, step
+
+
+def drain_engine(engine, timeout: float = 30.0,
+                 poll: float = 0.01) -> bool:
+    """Wait until a ContinuousBatchingEngine has no active rows and an
+    empty queue.  True when drained within ``timeout``; False when
+    streams are still running (the caller then leaves the old engine
+    alive — its stragglers finish on the swapped params rather than
+    erroring)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with engine._cv:
+            idle = (not engine._active.any()) and len(engine._queue) == 0
+        if idle:
+            return True
+        time.sleep(poll)
+    return False

@@ -1,0 +1,27 @@
+"""Process-global checkpoint counters (exposed via
+``alpa_tpu.monitoring.get_checkpoint_stats``).
+
+Counters are plain add-only floats/ints behind one lock; timings are
+accumulated seconds.  ``snapshot()`` returns a copy so callers can diff
+before/after an operation without racing the background writer thread.
+"""
+import threading
+from typing import Dict
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {}
+
+
+def incr(name: str, value: float = 1) -> None:
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def snapshot() -> Dict[str, float]:
+    with _LOCK:
+        return dict(_COUNTERS)
+
+
+def reset() -> None:
+    with _LOCK:
+        _COUNTERS.clear()

@@ -603,7 +603,13 @@ def make_snapshotter(snapshot_dir: str,
     ``state_provider()`` (a pytree of arrays) via
     ``serialization.save_checkpoint`` and blocks until the write lands —
     a recovery that later fails over to a fresh cluster restores from
-    here."""
+    here.
+
+    Prefer :class:`alpa_tpu.checkpoint.RecoveryCheckpointer` for new
+    code: it snapshots into the content-addressed store (verifiable,
+    retained, atomically committed) AND auto-restores the last verified
+    step when recovery brings the mesh back; this helper remains for
+    flat-directory snapshots with no retention."""
 
     def snapshot():
         from alpa_tpu.serialization import checkpoint_wait, save_checkpoint

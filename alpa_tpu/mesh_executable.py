@@ -143,6 +143,19 @@ class NormalMeshExecutable(MeshExecutable):
     def get_hlo_text(self) -> str:
         return self.compiled.as_text()
 
+    def get_plan_fingerprint(self) -> str:
+        """Content hash of this executable's parallel plan (mesh extent +
+        input/output avals and shardings) — the shard-parallel analog of
+        ``PipeshardDriverExecutable.get_plan_fingerprint``, consumed by
+        ``checkpoint.CheckpointManager`` resume validation."""
+        import hashlib
+        parts = [repr(tuple(self.physical_mesh.shape))]
+        parts.extend(str(a) for a in self.in_avals)
+        parts.extend(str(a) for a in self.out_avals)
+        parts.extend(str(s) for s in self.in_shardings)
+        parts.extend(str(s) for s in self.out_shardings)
+        return hashlib.sha256("\n".join(parts).encode()).hexdigest()
+
     def get_total_allocation_size(self) -> int:
         try:
             m = self.compiled.memory_analysis()

@@ -160,6 +160,7 @@ def dump_debug_info(executable, dump_dir: str):
     if hasattr(executable, "get_resharding_report"):
         write("resharding.txt", executable.get_resharding_report())
     write("compile_cache.txt", format_compile_cache_report())
+    write("checkpoint.txt", format_checkpoint_report())
     logger.info("debug info dumped to %s", dump_dir)
 
 
@@ -169,6 +170,28 @@ def get_compile_cache_stats() -> dict:
     See alpa_tpu/compile_cache.py."""
     from alpa_tpu.compile_cache import get_compile_cache
     return get_compile_cache().stats()
+
+
+def get_checkpoint_stats() -> dict:
+    """Process-global checkpoint counters (ISSUE 3): save/restore
+    latency and byte totals, chunk dedupe, verify failures, hot-swap
+    staging.  See alpa_tpu/checkpoint/metrics.py."""
+    from alpa_tpu.checkpoint import metrics
+    return metrics.snapshot()
+
+
+def format_checkpoint_report() -> str:
+    """Human-readable checkpoint counter report (scripts/ckpt_tool.py
+    ``stat`` and debug dumps)."""
+    stats = get_checkpoint_stats()
+    if not stats:
+        return "checkpoint: (no traffic yet)"
+    lines = ["checkpoint counters:"]
+    for key in sorted(stats):
+        v = stats[key]
+        val = f"{v:.4f}" if v != int(v) else str(int(v))
+        lines.append(f"  {key:<24} {val}")
+    return "\n".join(lines)
 
 
 def format_compile_cache_report() -> str:

@@ -55,6 +55,15 @@ class ParallelPlan:
         with open(filename, "wb") as f:
             pickle.dump(self, f)
 
+    def fingerprint(self) -> str:
+        """Stable content hash of the plan — checkpoint manifests record
+        it (``checkpoint.CheckpointManager.save(plan_fingerprint=...)``)
+        so resume can refuse weights saved under a different
+        parallelization.  Dataclass reprs are value-based, so two equal
+        plans hash identically across processes."""
+        import hashlib
+        return hashlib.sha256(repr(self).encode()).hexdigest()
+
     @classmethod
     def load(cls, filename: str) -> "ParallelPlan":
         with open(filename, "rb") as f:

@@ -33,6 +33,13 @@ logger = logging.getLogger(__name__)
 _SEP = "."
 
 
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint directory is incomplete or inconsistent (missing
+    shard files, truncated index, metadata/shard mismatch).  Raised by
+    validation up front, with the offending leaf named — instead of a
+    bare shape-mismatch error deep inside ``jax.device_put``."""
+
+
 def _leaf_dirname(path_parts) -> str:
     return _SEP.join(str(p) for p in path_parts) or "_root"
 
@@ -49,15 +56,42 @@ def _flatten_state_dict(sd, prefix=()):
 
 class _AsyncMover:
     """Background mover from local cache to the final directory
-    (ref DaemonMoveWorker)."""
+    (ref DaemonMoveWorker).
+
+    Failures are NOT fire-and-forget: every background move exception is
+    recorded and the first one re-raises from ``wait()`` (i.e.
+    ``checkpoint_wait()``), after removing the failed move's partial
+    destination — a half-drained leaf dir must not masquerade as a
+    complete checkpoint on the shared FS."""
 
     def __init__(self):
         self.threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._errors: List[BaseException] = []
 
     def submit(self, src: str, dst: str):
-        t = threading.Thread(target=self._move, args=(src, dst), daemon=True)
+        t = threading.Thread(target=self._move_safe, args=(src, dst),
+                             daemon=True)
         t.start()
         self.threads.append(t)
+
+    def _move_safe(self, src, dst):
+        try:
+            self._move(src, dst)
+        except BaseException as e:  # pylint: disable=broad-except
+            logger.exception("async checkpoint drain %s -> %s failed",
+                             src, dst)
+            # drop the partial destination: a leaf dir holding only some
+            # of its shards would restore as silently-wrong zeros
+            try:
+                if os.path.isdir(dst):
+                    shutil.rmtree(dst, ignore_errors=True)
+                elif os.path.exists(dst):
+                    os.unlink(dst)
+            except OSError:
+                logger.exception("cleanup of partial %s failed", dst)
+            with self._lock:
+                self._errors.append(e)
 
     @staticmethod
     def _move(src, dst):
@@ -79,6 +113,12 @@ class _AsyncMover:
         for t in self.threads:
             t.join()
         self.threads = []
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            raise CheckpointCorruptError(
+                f"{len(errors)} async checkpoint move(s) failed; first: "
+                f"{type(errors[0]).__name__}: {errors[0]}") from errors[0]
 
 
 _mover = _AsyncMover()
@@ -221,15 +261,67 @@ def _load_leaf(leaf_dir: str, shape, dtype, sharding=None,
         lambda idx: cb(idx))
 
 
+def validate_checkpoint(ckpt_dir: str, metadata: Optional[Dict] = None):
+    """Cross-check the checkpoint's index files against what is actually
+    on disk, BEFORE any array assembly: every leaf dir present, every
+    index entry's shard file present and non-empty, slices in bounds,
+    and the union of slices voluminous enough to cover the leaf.  Raises
+    :class:`CheckpointCorruptError` naming the first offending leaf."""
+    if metadata is None:
+        metadata = load_checkpoint_metadata(ckpt_dir)
+    n_proc = metadata.get("n_processes")
+    for name, info in metadata.get("leaves", {}).items():
+        leaf_dir = os.path.join(ckpt_dir, name)
+        if not os.path.isdir(leaf_dir):
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir} is missing leaf directory "
+                f"{name!r} (listed in metadata.json) — truncated or "
+                "partially-drained save")
+        index = _read_index(leaf_dir, n_proc)
+        if not index:
+            raise CheckpointCorruptError(
+                f"leaf {name!r} has no usable index entries in "
+                f"{leaf_dir} — empty or stale index files")
+        shape = tuple(info["shape"])
+        total = 1
+        for d in shape:
+            total *= d
+        covered = 0
+        for ent in index:
+            path = os.path.join(leaf_dir, ent["file"])
+            if not os.path.exists(path) or os.path.getsize(path) == 0:
+                raise CheckpointCorruptError(
+                    f"leaf {name!r}: shard file {ent['file']} is "
+                    f"missing or empty in {leaf_dir} — the index refers "
+                    "to a shard that never finished writing")
+            vol = 1
+            for (a, b), dim in zip(ent["slice"], shape):
+                if not 0 <= a < b <= dim:
+                    raise CheckpointCorruptError(
+                        f"leaf {name!r}: shard {ent['file']} covers "
+                        f"slice {ent['slice']} outside the leaf shape "
+                        f"{list(shape)} — index/metadata mismatch")
+                vol *= b - a
+            covered += vol
+        if covered < total:
+            raise CheckpointCorruptError(
+                f"leaf {name!r}: shards cover {covered} of {total} "
+                f"elements of shape {list(shape)} — missing shard "
+                "files (e.g. a process's flush never landed)")
+
+
 def restore_checkpoint(ckpt_dir: str,
                        target: Any,
                        shardings: Optional[Any] = None):
     """Restore into the structure of ``target``
     (ref serialization.py:137).  ``shardings``: optional pytree (matching
-    target) of NamedShardings; each host reads only its slices."""
-    with open(os.path.join(ckpt_dir, "metadata.json"),
-              encoding="utf-8") as f:
-        metadata = json.load(f)
+    target) of NamedShardings; each host reads only its slices.
+
+    The on-disk index is validated against the actual shard files first
+    (``validate_checkpoint``): a corrupt/truncated checkpoint raises
+    :class:`CheckpointCorruptError` up front."""
+    metadata = load_checkpoint_metadata(ckpt_dir)
+    validate_checkpoint(ckpt_dir, metadata)
     sd = to_state_dict(target)
     flat = _flatten_state_dict(sd)
     shard_flat = {}
@@ -261,6 +353,25 @@ def restore_checkpoint(ckpt_dir: str,
 
 
 def load_checkpoint_metadata(ckpt_dir: str) -> Dict:
-    with open(os.path.join(ckpt_dir, "metadata.json"),
-              encoding="utf-8") as f:
-        return json.load(f)
+    """Read and sanity-check ``metadata.json``.  A missing, unparsable,
+    or structurally-wrong file raises :class:`CheckpointCorruptError`
+    with the path named (instead of a stray ``JSONDecodeError`` or
+    ``KeyError`` later)."""
+    path = os.path.join(ckpt_dir, "metadata.json")
+    if not os.path.exists(path):
+        raise CheckpointCorruptError(
+            f"no metadata.json in {ckpt_dir} — not a checkpoint "
+            "directory, or the save died before metadata was written")
+    try:
+        with open(path, encoding="utf-8") as f:
+            metadata = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise CheckpointCorruptError(
+            f"metadata.json in {ckpt_dir} is unreadable ({e}) — "
+            "truncated write") from e
+    if not isinstance(metadata, dict) or \
+            not isinstance(metadata.get("leaves"), dict):
+        raise CheckpointCorruptError(
+            f"metadata.json in {ckpt_dir} lacks a 'leaves' table — "
+            "not a checkpoint metadata file")
+    return metadata

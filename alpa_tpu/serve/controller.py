@@ -61,6 +61,12 @@ class RequestBatcher:
                     f"{method}(); see serve.scheduler's queue protocol")
         self._queue = scheduler
         self._cv = threading.Condition()
+        # drain barrier for hot weight swaps: the worker holds this for
+        # the whole device-execution section of each batch, so whoever
+        # else acquires it (checkpoint.hot_swap via _Replica.swap_
+        # weights) is guaranteed no batch is mid-flight — queued
+        # requests simply wait and ride the next batch on new weights
+        self._gen_lock = threading.Lock()
         self.batches_run = 0          # introspection for tests
         # degraded mode: a broken custom scheduler demotes this batcher
         # to a fresh FIFO queue instead of failing queued requests
@@ -164,13 +170,14 @@ class RequestBatcher:
                 if not batch:
                     continue
             try:
-                prompts = [p for it in batch for p in it["prompts"]]
-                run_cfg = dataclasses.replace(
-                    batch[0]["cfg"],
-                    max_new_tokens=max(it["cfg"].max_new_tokens
-                                       for it in batch))
-                outs = self.generator.generate(prompts, run_cfg,
-                                               prefix=self.prefix)
+                with self._gen_lock:
+                    prompts = [p for it in batch for p in it["prompts"]]
+                    run_cfg = dataclasses.replace(
+                        batch[0]["cfg"],
+                        max_new_tokens=max(it["cfg"].max_new_tokens
+                                           for it in batch))
+                    outs = self.generator.generate(prompts, run_cfg,
+                                                   prefix=self.prefix)
                 self.batches_run += 1
                 i = 0
                 for it in batch:
@@ -207,6 +214,48 @@ class _Replica:
     def degraded(self) -> bool:
         return self.batcher.degraded
 
+    def swap_weights(self, new_params, prefix_ids=None,
+                     drain_timeout: float = 30.0) -> None:
+        """Swap this replica onto already-staged weights under a drain
+        barrier (the swap phase of checkpoint.hot_swap; the staged
+        params must share the current params' shapes/dtypes, so every
+        compiled executable is reused — the swap is a pointer flip).
+
+        Guarantees: the in-flight batch finishes on the OLD weights;
+        queued requests are never dropped and run on the NEW weights;
+        a shared-prefix model gets its prefix KV recomputed under the
+        barrier so no request ever mixes old prefix with new params.
+        The streaming engine is drained (bounded by ``drain_timeout``)
+        and lazily rebuilt; an undrained straggler stream finishes its
+        remaining tokens on the new weights rather than erroring.
+        """
+        from alpa_tpu.checkpoint.hot_swap import drain_engine
+
+        # Hold the replica lock first: new streaming requests acquire it
+        # in the `engine` property, so none can board the old engine
+        # while we retire it.
+        with self._lock:
+            old_engine = self._engine
+            drained = (old_engine is None or
+                       drain_engine(old_engine, timeout=drain_timeout))
+            # the drain barrier proper: wait out the in-flight batch
+            with self.batcher._gen_lock:
+                self.generator.params = new_params
+                new_prefix = None
+                if prefix_ids is not None:
+                    new_prefix = self.generator.cache_prefix(prefix_ids)
+                self.prefix = new_prefix
+                self.batcher.prefix = new_prefix
+            if old_engine is not None:
+                if drained:
+                    old_engine.shutdown()
+                else:
+                    logger.warning(
+                        "engine streams outlived the %.0fs drain window;"
+                        " leaving the old engine to finish them on the "
+                        "new weights", drain_timeout)
+                self._engine = None  # next stream builds a fresh engine
+
     @property
     def engine(self):
         """Lazy continuous-batching engine for streaming requests (so
@@ -239,6 +288,8 @@ class Controller:
         # with ServiceDegradedError (HTTP 503) until recovery clears it
         self._health = "ok"
         self._health_reason: Optional[str] = None
+        #: completed hot swaps, newest last (introspection + /admin)
+        self.reloads: List[Dict[str, Any]] = []
 
     # -- health / graceful degradation --------------------------------
 
@@ -340,6 +391,39 @@ class Controller:
     def list_models(self) -> List[str]:
         return sorted(self._models)
 
+    def reload_model(self, name: str, checkpoint_source,
+                     step: Optional[int] = None) -> Dict[str, Any]:
+        """Zero-downtime weight reload (``POST /admin/reload``).
+
+        Phase 1 (background, per replica): stage the checkpoint step
+        onto the replica's exact device placement, hash-verifying every
+        chunk — requests keep flowing on the old weights the whole time,
+        and a corrupt checkpoint fails here without touching serving.
+        Phase 2: swap each replica under its drain barrier
+        (:meth:`_Replica.swap_weights`) — in-flight requests finish on
+        the old weights, queued ones ride the new; nothing is dropped.
+        """
+        from alpa_tpu.checkpoint.hot_swap import (
+            stage_weights_from_checkpoint)
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"unknown model {name!r}; "
+                               f"registered: {sorted(self._models)}")
+            replicas = list(self._models[name])
+            prefix_ids = self._prefix_ids.get(name)
+        loaded_step = None
+        for replica in replicas:
+            new_params, loaded_step = stage_weights_from_checkpoint(
+                checkpoint_source, replica.generator.params, step=step)
+            replica.swap_weights(new_params, prefix_ids=prefix_ids)
+        result = {"model": name, "step": loaded_step,
+                  "replicas_swapped": len(replicas)}
+        with self._lock:
+            self.reloads.append(result)
+        logger.info("hot-swapped model %s to checkpoint step %s "
+                    "(%d replicas)", name, loaded_step, len(replicas))
+        return result
+
     def _pick_replica(self, name: str) -> _Replica:
         with self._lock:
             replicas = self._models[name]
@@ -419,6 +503,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
+        if self.path == "/admin/reload":
+            self._admin_reload()
+            return
         if self.path != "/completions":
             self._send(404, {"error": f"unknown path {self.path}"})
             return
@@ -439,6 +526,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": f"bad request: {e}"})
         except Exception as e:  # pylint: disable=broad-except
             logger.exception("completions failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _admin_reload(self):
+        """``POST /admin/reload`` {"model", "ckpt_dir", "step"?}: stage
+        + hash-verify the checkpoint in the background, then swap every
+        replica of the model under a drain barrier.  Requests in flight
+        during the call are served without interruption."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            request = json.loads(self.rfile.read(length) or b"{}")
+            name = request.get("model")
+            ckpt_dir = request.get("ckpt_dir")
+            if not name or not ckpt_dir:
+                raise ValueError(
+                    "reload needs 'model' and 'ckpt_dir' fields")
+            step = request.get("step")
+            result = self.controller.reload_model(
+                name, ckpt_dir, step=None if step is None else int(step))
+            self._send(200, result)
+        except KeyError as e:
+            self._send(404, {"error": str(e)})
+        except (json.JSONDecodeError, ValueError, TypeError,
+                FileNotFoundError) as e:
+            self._send(400, {"error": f"bad reload request: {e}"})
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception("hot reload failed")
             self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     def _stream(self, request):

@@ -1,0 +1,112 @@
+"""Checkpoint subsystem benchmark (ISSUE 3): save/restore throughput
+and the async-vs-sync training-loop blocking time.
+
+Measures, on a CPU mesh (no TPU needed — disk + hashing dominate):
+
+- sync save wall time and GB/s (chunk hashing + tmp/rename writes +
+  manifest fsync, inline);
+- async save *blocking* time (double-buffer join + device→host staging
+  only) and its ratio to the sync save — the <10% acceptance number;
+- dedupe-save time (same content again: all chunks hit the store);
+- restore GB/s with hash verification on and off.
+
+Usage:  python benchmark/checkpoint_bench.py [--mb 256] [--out F]
+
+Writes JSON next to the other suite results
+(benchmark/results/checkpoint_bench.json).
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results",
+                           "checkpoint_bench.json")
+
+
+def _state(total_mb: int, n_leaves: int = 8, seed: int = 0):
+    import numpy as np
+    per = total_mb * (1 << 20) // n_leaves // 4      # float32 elements
+    rng = np.random.default_rng(seed)
+    return {f"p{i}": rng.standard_normal(per).astype(np.float32)
+            for i in range(n_leaves)}
+
+
+def run(total_mb: int, root: str) -> dict:
+    from alpa_tpu.checkpoint.manager import CheckpointManager
+
+    nbytes = total_mb * (1 << 20)
+    gb = nbytes / (1 << 30)
+    result = {"state_mb": total_mb, "n_leaves": 8}
+
+    # -- sync save baseline -------------------------------------------
+    sync_mgr = CheckpointManager(os.path.join(root, "sync"))
+    state = _state(total_mb, seed=0)
+    t0 = time.perf_counter()
+    sync_mgr.save(1, state, sync=True)
+    t_sync = time.perf_counter() - t0
+    result["sync_save_seconds"] = round(t_sync, 4)
+    result["sync_save_gbps"] = round(gb / t_sync, 3)
+
+    # -- async save: blocking vs total --------------------------------
+    async_mgr = CheckpointManager(os.path.join(root, "async"))
+    state2 = _state(total_mb, seed=1)                # distinct: no dedupe
+    t0 = time.perf_counter()
+    async_mgr.save(1, state2)
+    blocking = async_mgr.last_blocking_seconds
+    async_mgr.wait()
+    t_total = time.perf_counter() - t0
+    result["async_blocking_seconds"] = round(blocking, 4)
+    result["async_staging_seconds"] = round(
+        async_mgr.last_staging_seconds, 4)
+    result["async_total_seconds"] = round(t_total, 4)
+    result["blocking_ratio_vs_sync"] = round(blocking / t_sync, 4)
+
+    # -- dedupe save (identical content, next step) -------------------
+    t0 = time.perf_counter()
+    async_mgr.save(2, state2, sync=True)
+    result["dedupe_save_seconds"] = round(time.perf_counter() - t0, 4)
+
+    # -- restore ------------------------------------------------------
+    for verify in (True, False):
+        t0 = time.perf_counter()
+        sync_mgr.restore(state, step=1, verify=verify)
+        dt = time.perf_counter() - t0
+        key = "restore_verified" if verify else "restore_unverified"
+        result[f"{key}_seconds"] = round(dt, 4)
+        result[f"{key}_gbps"] = round(gb / dt, 3)
+    return result
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--mb", type=int, default=256,
+                        help="total state size in MB (default 256)")
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    args = parser.parse_args()
+
+    root = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        result = run(args.mb, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    print(f"\nwrote {args.out}")
+    assert result["blocking_ratio_vs_sync"] < 0.10, (
+        "async save blocked >=10% of a sync save — the double buffer "
+        "or staging path regressed")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,149 @@
+"""Inspect / verify / garbage-collect a content-addressed checkpoint
+store (ISSUE 3; see docs/checkpointing.md).
+
+Usage::
+
+    python scripts/ckpt_tool.py inspect ROOT [--step N]
+    python scripts/ckpt_tool.py verify  ROOT [--step N | --all]
+    python scripts/ckpt_tool.py gc      ROOT [--keep-last-k K]
+                                             [--keep-every-n N]
+    python scripts/ckpt_tool.py stat
+
+``inspect`` lists committed steps (or one step's per-leaf chunk map);
+``verify`` re-hashes every chunk a step references and exits non-zero
+on corruption; ``gc`` optionally applies a retention policy, then
+deletes chunks no surviving manifest references (do NOT run it while a
+training run is saving into the same root); ``stat`` prints the
+process-global checkpoint counters.
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.checkpoint.policy import RetentionPolicy  # noqa: E402
+from alpa_tpu.checkpoint.store import (CheckpointNotFoundError,  # noqa: E402
+                                       ShardStore)
+
+
+def _store(args) -> ShardStore:
+    if not os.path.isdir(os.path.join(args.root, "manifests")):
+        sys.exit(f"{args.root} is not a checkpoint store "
+                 "(no manifests/ directory)")
+    return ShardStore(args.root)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def cmd_inspect(args):
+    store = _store(args)
+    if args.step is not None:
+        manifest = store.read_manifest(args.step)
+        print(f"step {manifest['step']}  "
+              f"plan={str(manifest.get('plan_fingerprint'))[:16]}  "
+              f"meta={manifest.get('meta')}")
+        print(f"{'leaf':<40} {'shape':<18} {'dtype':<10} "
+              f"{'chunks':>6} {'bytes':>10}")
+        for name, leaf in sorted(manifest["leaves"].items()):
+            nbytes = sum(e["nbytes"] for e in leaf["chunks"])
+            print(f"{name:<40} {str(tuple(leaf['shape'])):<18} "
+                  f"{leaf['dtype']:<10} {len(leaf['chunks']):>6} "
+                  f"{_fmt_bytes(nbytes):>10}")
+        return
+    steps = store.all_steps()
+    if not steps:
+        print(f"no committed steps in {args.root}")
+        return
+    print(f"{'step':>12} {'leaves':>7} {'chunks':>7} {'bytes':>10}")
+    for step in steps:
+        manifest = store.read_manifest(step)
+        n_chunks = sum(len(l["chunks"])
+                       for l in manifest["leaves"].values())
+        nbytes = sum(e["nbytes"] for l in manifest["leaves"].values()
+                     for e in l["chunks"])
+        print(f"{step:>12} {len(manifest['leaves']):>7} "
+              f"{n_chunks:>7} {_fmt_bytes(nbytes):>10}")
+
+
+def cmd_verify(args):
+    store = _store(args)
+    steps = store.all_steps() if args.all else \
+        [args.step if args.step is not None else store.latest_step()]
+    if steps == [None]:
+        sys.exit(f"no committed steps in {args.root}")
+    bad_steps = 0
+    for step in steps:
+        report = store.verify_step(step)
+        status = "OK" if report["ok"] else \
+            f"CORRUPT ({len(report['bad'])} bad chunks)"
+        print(f"step {report['step']}: {status}  "
+              f"({report['n_chunks']} chunks, "
+              f"{_fmt_bytes(report['n_bytes'])})")
+        for bad in report["bad"]:
+            print(f"  leaf {bad['leaf']}: {bad['error']}")
+        bad_steps += 0 if report["ok"] else 1
+    if bad_steps:
+        sys.exit(f"{bad_steps}/{len(steps)} steps failed verification")
+
+
+def cmd_gc(args):
+    store = _store(args)
+    if args.keep_last_k or args.keep_every_n:
+        policy = RetentionPolicy(keep_last_k=args.keep_last_k,
+                                 keep_every_n=args.keep_every_n)
+        doomed = policy.to_delete(store.all_steps())
+        for step in doomed:
+            store.delete_step(step)
+        print(f"retention dropped steps {doomed or '[]'} "
+              f"(surviving: {store.all_steps()})")
+    result = store.gc()
+    print(f"gc removed {result['chunks_removed']} chunks, "
+          f"freed {_fmt_bytes(result['bytes_freed'])}")
+
+
+def cmd_stat(args):
+    from alpa_tpu.monitoring import format_checkpoint_report
+    print(format_checkpoint_report())
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("inspect", help="list steps / one step's leaves")
+    p.add_argument("root")
+    p.add_argument("--step", type=int)
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("verify", help="re-hash every referenced chunk")
+    p.add_argument("root")
+    p.add_argument("--step", type=int)
+    p.add_argument("--all", action="store_true")
+    p.set_defaults(fn=cmd_verify)
+
+    p = sub.add_parser("gc", help="retention + unreferenced-chunk gc")
+    p.add_argument("root")
+    p.add_argument("--keep-last-k", type=int, default=0)
+    p.add_argument("--keep-every-n", type=int, default=0)
+    p.set_defaults(fn=cmd_gc)
+
+    p = sub.add_parser("stat", help="process-global counters")
+    p.set_defaults(fn=cmd_stat)
+
+    args = parser.parse_args()
+    try:
+        args.fn(args)
+    except CheckpointNotFoundError as e:
+        sys.exit(str(e))
+
+
+if __name__ == "__main__":
+    main()

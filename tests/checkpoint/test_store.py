@@ -1,0 +1,224 @@
+"""Content-addressed shard store: atomicity, integrity, retention.
+
+Covers the ISSUE 3 acceptance points that live at the store layer: a
+``kill -9`` simulated between chunk write and manifest commit leaves the
+prior step as the latest restorable one, and retention GC removes
+exactly the chunks no surviving manifest references.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from alpa_tpu.checkpoint import metrics
+from alpa_tpu.checkpoint.policy import RetentionPolicy
+from alpa_tpu.checkpoint.store import (ChunkCorruptionError,
+                                       CheckpointNotFoundError, ShardStore)
+
+
+def _leaves(arr, name="w"):
+    index = tuple((0, d) for d in arr.shape) if arr.ndim else ()
+    return {name: {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                   "pieces": [(index, arr)]}}
+
+
+def _full(shape):
+    return tuple((0, d) for d in shape) if shape else ()
+
+
+class TestChunks:
+
+    def test_put_read_roundtrip_and_dedupe(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        data = b"hello chunk"
+        h1 = store.put_chunk(data)
+        h2 = store.put_chunk(data)
+        assert h1 == h2
+        assert store.read_chunk(h1) == data
+        # exactly one file on disk for the duplicate put
+        assert os.path.exists(store.chunk_path(h1))
+
+    def test_corrupt_chunk_detected(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        h = store.put_chunk(b"precious bytes")
+        with open(store.chunk_path(h), "wb") as f:
+            f.write(b"precious BYTES")          # same length, flipped bits
+        with pytest.raises(ChunkCorruptionError, match="hash"):
+            store.read_chunk(h)
+        # verify=False trusts the name (the fast path hot_swap avoids)
+        assert store.read_chunk(h, verify=False) == b"precious BYTES"
+
+    def test_missing_chunk_is_corruption(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        h = store.put_chunk(b"x")
+        os.unlink(store.chunk_path(h))
+        with pytest.raises(ChunkCorruptionError, match="missing"):
+            store.read_chunk(h)
+
+
+class TestManifestAtomicity:
+
+    def test_crash_between_chunks_and_commit(self, tmp_path):
+        """kill -9 mid-save: chunks on disk, no manifest — the step does
+        not exist and the prior step stays latest AND fully verified."""
+        store = ShardStore(str(tmp_path))
+        good = np.arange(32.0, dtype=np.float32)
+        store.write_step(1, _leaves(good))
+
+        # simulate the kill: write step 2's chunks but die before commit
+        doomed = np.full(64, 7.0, dtype=np.float32)
+        store.put_chunk(np.ascontiguousarray(doomed).tobytes())
+
+        assert store.all_steps() == [1]
+        assert store.latest_step() == 1
+        assert store.last_verified_step() == 1
+        report = store.verify_step(1)
+        assert report["ok"] and report["n_chunks"] == 1
+        out = store.read_leaf_slice(store.read_manifest(1)["leaves"]["w"],
+                                    _full(good.shape))
+        np.testing.assert_array_equal(out, good)
+        # gc reclaims the orphaned chunks of the dead save
+        removed = store.gc()
+        assert removed["chunks_removed"] == 1
+        assert store.verify_step(1)["ok"]
+
+    def test_crash_during_commit_leaves_no_manifest(self, tmp_path,
+                                                    monkeypatch):
+        store = ShardStore(str(tmp_path))
+        store.write_step(5, _leaves(np.ones(4, np.float32)))
+
+        real_rename = os.rename
+
+        def dying_rename(src, dst):
+            if "manifests" in dst:
+                raise OSError("simulated kill -9 during rename")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", dying_rename)
+        with pytest.raises(OSError):
+            store.write_step(6, _leaves(np.zeros(4, np.float32)))
+        monkeypatch.undo()
+        assert store.latest_step() == 5
+        assert store.last_verified_step() == 5
+
+    def test_read_missing_step(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        with pytest.raises(CheckpointNotFoundError):
+            store.read_manifest()
+        with pytest.raises(CheckpointNotFoundError):
+            store.read_manifest(3)
+
+
+class TestVerification:
+
+    def test_verify_step_flags_corruption(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        arr = np.random.default_rng(0).standard_normal(128).astype(
+            np.float32)
+        manifest = store.write_step(1, _leaves(arr))
+        h = manifest["leaves"]["w"]["chunks"][0]["hash"]
+        with open(store.chunk_path(h), "r+b") as f:
+            f.write(b"\x00\x00\x00\x00")
+        report = store.verify_step(1)
+        assert not report["ok"]
+        assert report["bad"][0]["leaf"] == "w"
+        assert store.last_verified_step() is None
+
+    def test_last_verified_skips_corrupt_newest(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        store.write_step(1, _leaves(np.arange(8, dtype=np.int32)))
+        m2 = store.write_step(2, _leaves(np.arange(8, 16,
+                                                   dtype=np.int32)))
+        os.unlink(store.chunk_path(m2["leaves"]["w"]["chunks"][0]["hash"]))
+        assert store.latest_step() == 2
+        assert store.last_verified_step() == 1
+
+
+class TestChunkingAndResharding:
+
+    def test_large_piece_splits_and_reassembles(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        arr = np.random.default_rng(1).standard_normal(
+            (64, 32)).astype(np.float32)
+        manifest = store.write_step(1, _leaves(arr), chunk_bytes=1024)
+        ents = manifest["leaves"]["w"]["chunks"]
+        assert len(ents) > 1                       # actually chunked
+        out = store.read_leaf_slice(manifest["leaves"]["w"],
+                                    _full(arr.shape))
+        np.testing.assert_array_equal(out, arr)
+
+    def test_read_arbitrary_slice_across_chunk_boundaries(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        arr = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+        manifest = store.write_step(1, _leaves(arr), chunk_bytes=256)
+        leaf = manifest["leaves"]["w"]
+        # a slice no single saved chunk covers
+        out = store.read_leaf_slice(leaf, ((5, 40), (2, 7)))
+        np.testing.assert_array_equal(out, arr[5:40, 2:7])
+
+    def test_hole_in_index_map_raises(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        arr = np.ones((8, 4), np.float32)
+        manifest = store.write_step(1, _leaves(arr), chunk_bytes=64)
+        leaf = json.loads(json.dumps(manifest["leaves"]["w"]))
+        assert len(leaf["chunks"]) > 1
+        del leaf["chunks"][0]                           # half missing
+        with pytest.raises(ChunkCorruptionError, match="holes"):
+            store.read_leaf_slice(leaf, _full((8, 4)))
+
+    def test_scalar_leaf(self, tmp_path):
+        store = ShardStore(str(tmp_path))
+        arr = np.float32(3.25)
+        manifest = store.write_step(1, _leaves(arr))
+        out = store.read_leaf_slice(manifest["leaves"]["w"], ())
+        assert out.shape == () and out == np.float32(3.25)
+
+
+class TestRetentionGC:
+
+    def test_policy_selection(self):
+        pol = RetentionPolicy(keep_last_k=2, keep_every_n=10)
+        steps = [1, 5, 10, 15, 20, 21, 22]
+        assert pol.surviving(steps) == [10, 20, 21, 22]
+        assert pol.to_delete(steps) == [1, 5, 15]
+        assert RetentionPolicy(keep_last_k=0).to_delete(steps) == []
+
+    def test_gc_removes_only_unreferenced_chunks(self, tmp_path):
+        """keep-last-K: deleted steps' chunks vanish UNLESS a surviving
+        manifest still references them (content addressing shares
+        chunks across steps)."""
+        store = ShardStore(str(tmp_path))
+        shared = np.arange(16, dtype=np.float32)       # same every step
+        for step in (1, 2, 3):
+            unique = np.full(16, float(step), np.float32)
+            leaves = {}
+            leaves.update(_leaves(shared, "frozen"))
+            leaves.update(_leaves(unique, "hot"))
+            store.write_step(step, leaves)
+
+        pol = RetentionPolicy(keep_last_k=2)
+        doomed_hash = store.read_manifest(1)["leaves"]["hot"]["chunks"][0][
+            "hash"]
+        shared_hash = store.read_manifest(1)["leaves"]["frozen"]["chunks"][
+            0]["hash"]
+        for s in pol.to_delete(store.all_steps()):
+            store.delete_step(s)
+        result = store.gc()
+
+        assert store.all_steps() == [2, 3]
+        assert result["chunks_removed"] == 1           # step 1's "hot"
+        assert not store.has_chunk(doomed_hash)
+        assert store.has_chunk(shared_hash)            # still referenced
+        for s in (2, 3):
+            assert store.verify_step(s)["ok"]
+
+    def test_gc_metrics_accumulate(self, tmp_path):
+        metrics.reset()
+        store = ShardStore(str(tmp_path))
+        store.write_step(1, _leaves(np.ones(8, np.float32)))
+        store.delete_step(1)
+        store.gc()
+        stats = metrics.snapshot()
+        assert stats["gc_chunks_removed"] == 1
+        assert stats["gc_bytes_freed"] == 32

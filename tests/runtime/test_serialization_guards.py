@@ -1,0 +1,160 @@
+"""Legacy checkpoint guard rails (ISSUE 3 satellites): async-mover
+failures must surface from checkpoint_wait(), and corrupt checkpoint
+directories must fail validation with a named leaf — not a stray shape
+error deep inside restore."""
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from alpa_tpu import serialization
+from alpa_tpu.serialization import (CheckpointCorruptError, _AsyncMover,
+                                    checkpoint_wait, restore_checkpoint,
+                                    save_checkpoint, validate_checkpoint)
+
+
+def _state():
+    return {"w": np.arange(8, dtype=np.float32),
+            "b": np.ones((2, 3), np.float32)}
+
+
+class TestAsyncMoverFailures:
+
+    def test_failure_raises_from_wait_and_cleans_partial(self, tmp_path):
+        mover = _AsyncMover()
+        src = tmp_path / "src_leaf"
+        dst = tmp_path / "final" / "leaf"
+        src.mkdir()
+        (src / "shard_p0_0.npy").write_bytes(b"x" * 16)
+        (src / "shard_p0_1.npy").write_bytes(b"y" * 16)
+
+        real_move = _AsyncMover._move
+
+        def dying_move(s, d):
+            # copy half, then die — leaves a partial destination
+            os.makedirs(d, exist_ok=True)
+            shutil.copy(os.path.join(s, "shard_p0_0.npy"),
+                        os.path.join(d, "shard_p0_0.npy"))
+            raise OSError("NFS went away")
+
+        mover._move = dying_move
+        mover.submit(str(src), str(dst))
+        with pytest.raises(CheckpointCorruptError, match="NFS went away"):
+            mover.wait()
+        # the partial leaf dir was removed: it cannot masquerade as a
+        # complete checkpoint on the shared FS
+        assert not dst.exists()
+        # the error was consumed; the mover keeps working
+        mover._move = real_move
+        mover.submit(str(src), str(dst))
+        mover.wait()
+        assert sorted(os.listdir(dst)) == ["shard_p0_0.npy",
+                                           "shard_p0_1.npy"]
+
+    def test_save_with_cache_dir_surfaces_drain_failure(
+            self, tmp_path, monkeypatch):
+        calls = []
+        real_move = _AsyncMover._move
+
+        def boom_first(src, dst):
+            calls.append(src)
+            if len(calls) == 1:
+                raise OSError("disk full")
+            return real_move(src, dst)
+
+        monkeypatch.setattr(_AsyncMover, "_move",
+                            staticmethod(boom_first))
+        save_checkpoint(str(tmp_path / "final"), _state(), step=1,
+                        local_cache_dir=str(tmp_path / "cache"))
+        with pytest.raises(CheckpointCorruptError, match="disk full"):
+            checkpoint_wait()
+        # a second wait is clean (errors are one-shot)
+        checkpoint_wait()
+
+
+class TestValidateCheckpoint:
+
+    def _save(self, tmp_path):
+        ckpt = str(tmp_path / "ckpt")
+        save_checkpoint(ckpt, _state(), step=1)
+        return ckpt
+
+    def test_happy_path(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        validate_checkpoint(ckpt)                       # no raise
+        restored = restore_checkpoint(ckpt, _state())
+        np.testing.assert_array_equal(restored["w"],
+                                      np.arange(8, dtype=np.float32))
+
+    def test_missing_leaf_dir(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        shutil.rmtree(os.path.join(ckpt, "w"))
+        with pytest.raises(CheckpointCorruptError,
+                           match="missing leaf directory"):
+            restore_checkpoint(ckpt, _state())
+
+    def test_missing_shard_file(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        os.unlink(os.path.join(ckpt, "w", "shard_p0_0.npy"))
+        with pytest.raises(CheckpointCorruptError,
+                           match="missing or empty"):
+            restore_checkpoint(ckpt, _state())
+
+    def test_empty_shard_file(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        open(os.path.join(ckpt, "w", "shard_p0_0.npy"), "w").close()
+        with pytest.raises(CheckpointCorruptError,
+                           match="missing or empty"):
+            validate_checkpoint(ckpt)
+
+    def test_empty_index(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        with open(os.path.join(ckpt, "w", "index_p0.json"), "w") as f:
+            json.dump([], f)
+        with pytest.raises(CheckpointCorruptError,
+                           match="no usable index"):
+            validate_checkpoint(ckpt)
+
+    def test_out_of_bounds_slice(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        idx = os.path.join(ckpt, "w", "index_p0.json")
+        with open(idx) as f:
+            index = json.load(f)
+        index[0]["slice"] = [[0, 16]]                  # leaf shape is (8,)
+        with open(idx, "w") as f:
+            json.dump(index, f)
+        with pytest.raises(CheckpointCorruptError, match="outside"):
+            validate_checkpoint(ckpt)
+
+    def test_coverage_hole(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        idx = os.path.join(ckpt, "w", "index_p0.json")
+        with open(idx) as f:
+            index = json.load(f)
+        index[0]["slice"] = [[0, 4]]                   # half the leaf
+        with open(idx, "w") as f:
+            json.dump(index, f)
+        with pytest.raises(CheckpointCorruptError, match="cover"):
+            validate_checkpoint(ckpt)
+
+    def test_metadata_missing(self, tmp_path):
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(CheckpointCorruptError,
+                           match="no metadata.json"):
+            restore_checkpoint(str(tmp_path / "empty"), _state())
+
+    def test_metadata_truncated_json(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        with open(os.path.join(ckpt, "metadata.json"), "w") as f:
+            f.write('{"step": 1, "leav')
+        with pytest.raises(CheckpointCorruptError, match="unreadable"):
+            restore_checkpoint(ckpt, _state())
+
+    def test_metadata_wrong_structure(self, tmp_path):
+        ckpt = self._save(tmp_path)
+        with open(os.path.join(ckpt, "metadata.json"), "w") as f:
+            json.dump({"step": 1}, f)
+        with pytest.raises(CheckpointCorruptError, match="leaves"):
+            restore_checkpoint(ckpt, _state())
