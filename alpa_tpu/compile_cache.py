@@ -48,7 +48,12 @@ logger = logging.getLogger(__name__)
 _ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
 
 # Bump to invalidate every persisted entry on cache-format changes.
-CACHE_FORMAT_VERSION = 1
+# v2 (ISSUE 4): register-file lowering became dataflow-graph aware
+# (overlap dispatch), so plans cached against the v1 instruction
+# semantics must never hit; disk payloads are now wrapped in a
+# ``{"__cache_format__": N, "payload": ...}`` envelope so tooling can
+# report which on-disk entries carry the current format.
+CACHE_FORMAT_VERSION = 2
 
 
 def _jax_version() -> str:
@@ -80,6 +85,23 @@ def fingerprint_parts(parts: Sequence[Any]) -> str:
         h.update(f"|{len(b)}|".encode())
         h.update(b)
     return h.hexdigest()
+
+
+def read_entry_format(path: str) -> Optional[int]:
+    """The cache-format version a disk entry was written with: the
+    envelope's ``__cache_format__`` for v2+ entries, 1 for bare legacy
+    payloads (pre-dataflow-graph lowering), None if unreadable."""
+    try:
+        with open(path, "rb") as f:
+            value = pickle.load(f)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    if isinstance(value, dict) and "__cache_format__" in value:
+        try:
+            return int(value["__cache_format__"])
+        except (TypeError, ValueError):
+            return None
+    return 1
 
 
 @dataclasses.dataclass
@@ -164,6 +186,11 @@ class CompileCache:
             try:
                 with open(path, "rb") as f:
                     value = pickle.load(f)
+                # v2 envelope; tolerate bare legacy payloads (their keys
+                # embed the old format version so they can only be read
+                # by explicit tooling, never hit by lookups)
+                if isinstance(value, dict) and "__cache_format__" in value:
+                    value = value["payload"]
             except Exception as e:  # pylint: disable=broad-except
                 # a truncated/stale entry is a miss, never an error
                 logger.warning("compile cache entry %s unreadable (%s); "
@@ -198,7 +225,8 @@ class CompileCache:
                                        prefix=".tmp-" + namespace)
             try:
                 with os.fdopen(fd, "wb") as f:
-                    pickle.dump(value, f)
+                    pickle.dump({"__cache_format__": CACHE_FORMAT_VERSION,
+                                 "payload": value}, f)
                 os.replace(tmp, path)  # atomic publish
             except BaseException:
                 try:

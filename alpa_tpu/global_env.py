@@ -63,15 +63,20 @@ class GlobalConfig:
         self.resharding_loadbalance_mode = os.environ.get(
             "ALPA_TPU_RESHARDING_LOADBALANCE", "normal")
         # Pipeline instruction dispatch:
-        # "auto" | "registers" | "sequential" | "threaded".
+        # "auto" | "registers" | "overlap" | "sequential" | "threaded".
         # "registers" replays the build-time register-file lowering (flat
         # slot buffers + precomputed index tuples + cached resharding
         # executors — no dict hashing or sharding resolution per call);
+        # "overlap" replays the lowering's instruction dataflow graph
+        # with cross-mesh RESHARDs launched eagerly on a transfer pool
+        # the moment their producers retire (bounded in-flight window);
         # "threaded" runs the emitter's per-mesh instruction streams on
         # worker threads (the per-host stream analog of ref
-        # runtime_emitter's per-worker lists); "auto" picks registers when
-        # eligible (single process, device_put resharding, no fault/trace/
-        # race instrumentation) and falls back to the interpreter
+        # runtime_emitter's per-worker lists); "auto" picks overlap when
+        # eligible (register-eligible AND multi-mesh with cross-mesh
+        # RESHARDs AND overlap_resharding), else registers when eligible
+        # (single process, device_put resharding, no fault/trace/
+        # race instrumentation), and falls back to the interpreter
         # otherwise.  Multi-process always dispatches sequentially:
         # collectives must be issued in the same order on every process.
         self.pipeline_dispatch_mode = os.environ.get(
@@ -92,8 +97,36 @@ class GlobalConfig:
         # replicating (ref: grad accumulation + apply grad placement).
         self.pipeline_distributed_apply_grad = True
         # Whether pipeshard runtime overlaps resharding with compute by
-        # issuing transfers as soon as producers finish (async dispatch).
-        self.overlap_resharding = True
+        # issuing transfers as soon as producers finish.  This is the
+        # gate for the "overlap" dispatch mode under
+        # pipeline_dispatch_mode="auto": set False to pin auto on the
+        # synchronous register replay.
+        self.overlap_resharding = _env_bool(
+            "ALPA_TPU_OVERLAP_RESHARDING", True)
+        # In-flight transfer window for overlap dispatch (caps how many
+        # cross-mesh RESHARDs may be launched but unwaited, bounding
+        # staging memory).  0 = auto: use the pipeline schedule's
+        # overlap_window_hint().
+        self.overlap_inflight_window = int(os.environ.get(
+            "ALPA_TPU_OVERLAP_WINDOW", "0"))
+        # Treat every cross-mesh transfer as synchronous: block until the
+        # destination arrays have materialized before returning.  The CPU
+        # test backend's copies are fully asynchronous, so RESHARD never
+        # blocks the dispatching thread there; multi-host send/recv
+        # backends do block.  This knob emulates that regime (used by
+        # benchmark/bench_dispatch.py's reshard-dominated payload to
+        # compare dispatch modes under blocking transfers).
+        self.sync_resharding_transfers = _env_bool(
+            "ALPA_TPU_SYNC_TRANSFERS", False)
+        # Emulated wire latency per cross-mesh transfer call, in seconds
+        # (implies synchronous semantics: the transfer materializes, then
+        # the calling thread idles for the latency).  The CPU test
+        # backend moves shards with an in-process memcpy, so the
+        # send/recv wire time a real multi-host link adds is absent;
+        # this knob reintroduces it so the dispatch-mode benchmark can
+        # measure how much of that idle time each mode hides.  0 = off.
+        self.resharding_transfer_latency_s = float(os.environ.get(
+            "ALPA_TPU_TRANSFER_LATENCY", "0"))
 
         # ---------- compile cache ----------
         # On-disk tier of the persistent compile cache (ILP auto-sharding

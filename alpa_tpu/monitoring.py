@@ -161,6 +161,7 @@ def dump_debug_info(executable, dump_dir: str):
         write("resharding.txt", executable.get_resharding_report())
     write("compile_cache.txt", format_compile_cache_report())
     write("checkpoint.txt", format_checkpoint_report())
+    write("overlap.txt", format_overlap_report())
     logger.info("debug info dumped to %s", dump_dir)
 
 
@@ -191,6 +192,48 @@ def format_checkpoint_report() -> str:
         v = stats[key]
         val = f"{v:.4f}" if v != int(v) else str(int(v))
         lines.append(f"  {key:<24} {val}")
+    return "\n".join(lines)
+
+
+def get_overlap_stats() -> dict:
+    """Process-global overlap-dispatch counters (ISSUE 4): per-step
+    transfer pool busy/blocked time, hoisted-launch counts, the last
+    step's overlap fraction, plus the resharding planner's link-load
+    aggregates (total / broadcast / max-link bytes over all plans)."""
+    from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+        get_planner_stats)
+    from alpa_tpu.pipeline_parallel.runtime_emitter import (
+        get_overlap_runtime_stats)
+    stats = {"runtime": get_overlap_runtime_stats(),
+             "planner": get_planner_stats()}
+    return stats
+
+
+def format_overlap_report() -> str:
+    """Human-readable overlap-dispatch report (debug dumps)."""
+    stats = get_overlap_stats()
+    rt, pl = stats["runtime"], stats["planner"]
+    lines = ["overlap dispatch (runtime):"]
+    if rt["steps"] == 0:
+        lines.append("  (no overlap-mode steps yet)")
+    else:
+        busy, blocked = rt["transfer_busy_s"], rt["wait_blocked_s"]
+        lines.append(f"  steps={rt['steps']} launches={rt['n_launches']} "
+                     f"hoisted={rt['n_hoisted']} "
+                     f"window={rt['last_window']}")
+        lines.append(f"  transfer_busy={busy:.4f}s "
+                     f"wait_blocked={blocked:.4f}s "
+                     f"last_overlap_fraction="
+                     f"{rt['last_overlap_fraction']:.3f}")
+    lines.append("resharding planner (link loads):")
+    if pl["plans"] == 0:
+        lines.append("  (no plans yet)")
+    else:
+        lines.append(f"  plans={pl['plans']} "
+                     f"total_bytes={pl['total_bytes']:.0f} "
+                     f"broadcast_bytes={pl['broadcast_bytes']:.0f}")
+        lines.append(f"  max_link_bytes={pl['max_link_bytes']:.0f} "
+                     f"(naive {pl['max_link_bytes_naive']:.0f})")
     return "\n".join(lines)
 
 

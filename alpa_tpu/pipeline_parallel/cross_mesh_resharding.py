@@ -143,16 +143,40 @@ class ReshardingTaskSpec:
     # the executor verify the runtime array's layout matches the plan
     src_tiles: Tuple[Tile, ...] = ()
     dst_tiles: Tuple[Tile, ...] = ()
+    # element size of the payload dtype (ISSUE 4 link accounting)
+    itemsize: int = 1
+    # bytes crossing under broadcast execution: each unique fetched tile
+    # of each replica group crosses ONCE (vs transfer_bytes, which counts
+    # the send_recv plan — once per requesting dst shard)
+    broadcast_bytes: float = 0.0
+    # planner objective (ISSUE 4, arXiv:2211.05322 load balancing): the
+    # busiest single link — max over per-src-device egress bytes and
+    # per-dst-device ingress bytes — under this plan's routing, and under
+    # the naive routing (first-holder selection) for comparison
+    max_link_bytes: float = 0.0
+    max_link_bytes_naive: float = 0.0
+    # same objective for broadcast execution (unique tiles routed across
+    # replica-group members vs all to the group's first holder)
+    max_link_bytes_broadcast: float = 0.0
+    max_link_bytes_broadcast_naive: float = 0.0
+    # greedy least-loaded-egress ordering of the plan's (request, src)
+    # moves; empty = plan order (see plan_send_order)
+    send_order: Tuple[Tuple[int, int], ...] = ()
+    # whether load-balanced source selection / routing was applied
+    loadbalanced: bool = True
 
     def total_tiles(self):
         return sum(len(r.srcs) for r in self.requests)
 
 
 def _cover_tile(dst_tile: Tile, src_vda: VirtualDistributedArray,
-                load: Dict[int, float], itemsize: int) -> List[TileSlice]:
+                load: Dict[int, float], itemsize: int,
+                balance: bool = True) -> List[TileSlice]:
     """Cover ``dst_tile`` with pieces of source shards, choosing the least
     loaded source when a piece is replicated (ref load-balanced sender
-    selection, cross_mesh_resharding.py:1448+)."""
+    selection, cross_mesh_resharding.py:1448+).  ``balance=False`` always
+    picks the first holder — the naive baseline the planner reports
+    against."""
     pieces: List[TileSlice] = []
     # Collect candidate intersections per unique source tile.
     for tile_slices, holders in src_vda.unique_tiles.items():
@@ -160,38 +184,27 @@ def _cover_tile(dst_tile: Tile, src_vda: VirtualDistributedArray,
         inter = dst_tile.intersect(src_tile)
         if inter is None:
             continue
-        # pick least-loaded holder
-        best = min(holders, key=lambda i: load.get(i, 0.0))
+        if balance:
+            # pick least-loaded holder
+            best = min(holders, key=lambda i: load.get(i, 0.0))
+        else:
+            best = holders[0]
         load[best] = load.get(best, 0.0) + inter.size * itemsize
         pieces.append(
             TileSlice(inter, best, inter.offset_in(src_tile)))
     return pieces
 
 
-def plan_resharding(shape: Tuple[int, ...],
-                    itemsize: int,
-                    src_sharding,
-                    dst_sharding,
-                    allow_allgather_rewrite: bool = True
-                    ) -> ReshardingTaskSpec:
-    """Compute the transfer plan for one cross-mesh value
-    (ref CrossMeshCommunicator._compile_resharding_specs:935)."""
-    src_vda = VirtualDistributedArray.from_sharding(shape, src_sharding)
-    dst_vda = VirtualDistributedArray.from_sharding(shape, dst_sharding)
-
-    # Local-allgather rewrite (MLSys'23): if several destination shards
-    # request the SAME tile (dst replicates over some axis), fetching it
-    # once per replica wastes DCN.  Rewrite: each replica group member
-    # fetches a disjoint 1/k slice; the destination mesh all-gathers over
-    # ICI.  We mark the spec; the executor realizes the gather with a
-    # resharded device_put + with_sharding_constraint (XLA collective).
+def _build_requests(src_vda: VirtualDistributedArray,
+                    dst_vda: VirtualDistributedArray,
+                    itemsize: int, allgather_rewrite: bool,
+                    balance: bool) -> Tuple[List[DstTileRequest], float]:
+    """The tile-coverage core of :func:`plan_resharding`: one
+    DstTileRequest per destination shard (or per replica-group split part
+    under the allgather rewrite), plus the total planned cross bytes."""
     dst_unique = dst_vda.unique_tiles
-    replication = max(len(v) for v in dst_unique.values()) \
-        if dst_unique else 1
-    allgather_rewrite = allow_allgather_rewrite and replication > 1
-
     load: Dict[int, float] = {}
-    requests = []
+    requests: List[DstTileRequest] = []
     total = 0.0
     if allgather_rewrite:
         # fetch each unique tile once, split across its replica group
@@ -217,27 +230,261 @@ def plan_resharding(shape: Tuple[int, ...],
                     sl = list(dst_tile.slices)
                     sl[split_dim] = (a + gi * step, a + (gi + 1) * step)
                     part = Tile(tuple(sl))
-                srcs = _cover_tile(part, src_vda, load, itemsize)
+                srcs = _cover_tile(part, src_vda, load, itemsize, balance)
                 requests.append(DstTileRequest(holder, part, srcs))
                 total += sum(s.tile.size for s in srcs) * itemsize
     else:
         for i, dst_tile in enumerate(dst_vda.device_tiles):
-            srcs = _cover_tile(dst_tile, src_vda, load, itemsize)
+            srcs = _cover_tile(dst_tile, src_vda, load, itemsize, balance)
             requests.append(DstTileRequest(i, dst_tile, srcs))
             total += sum(s.tile.size for s in srcs) * itemsize
+    return requests, total
 
-    return ReshardingTaskSpec(tuple(shape), requests, total,
+
+########################################
+# link-load accounting + broadcast routing (ISSUE 4)
+########################################
+
+
+def route_broadcast(spec: ReshardingTaskSpec,
+                    loadbalance: bool = True) -> Dict[Tuple, int]:
+    """Route each unique fetched tile of each replica group to ONE group
+    member for broadcast execution.
+
+    Naive routing (``loadbalance=False``, the pre-ISSUE-4 behavior) sends
+    every unique tile to the group's FIRST holder, concentrating the
+    whole group's ingress on one device; balanced routing spreads unique
+    tiles across the group by least accumulated ingress bytes, so a
+    source tile fanning out to many destination devices loads each
+    destination link evenly (arXiv:2211.05322 send-order balancing).  The
+    intra-mesh assembly leg unions pieces across the whole group, so any
+    member may receive any tile.
+
+    Returns ``(group_tile_slices, tile_slices) -> dst shard index``.
+    """
+    groups = VirtualDistributedArray(
+        spec.shape, list(spec.dst_tiles),
+        list(spec.dst_device_ids)).unique_tiles
+    itemsize = spec.itemsize or 1
+    ingress: Dict[int, float] = {}
+    routes: Dict[Tuple, int] = {}
+    for req in spec.requests:
+        gslices = spec.dst_tiles[req.dst_shard_index].slices
+        holders = groups[gslices]
+        for ts in req.srcs:
+            key = (gslices, ts.tile.slices)
+            if key in routes:
+                continue
+            if loadbalance:
+                target = min(holders,
+                             key=lambda h: (ingress.get(h, 0.0), h))
+            else:
+                target = holders[0]
+            routes[key] = target
+            ingress[target] = (ingress.get(target, 0.0) +
+                               ts.tile.size * itemsize)
+    return routes
+
+
+def compute_link_loads(spec: ReshardingTaskSpec,
+                       broadcast: bool = False,
+                       loadbalance: bool = True) -> Dict[str, Any]:
+    """Per-device link loads of one plan: egress bytes per source device,
+    ingress bytes per destination device, their max (the planner's
+    max-link objective), and the total bytes crossing.
+
+    ``broadcast=True`` accounts broadcast execution — each unique fetched
+    tile of a replica group crosses once, to the device
+    :func:`route_broadcast` picks; otherwise every (request, src) move of
+    the send_recv plan is counted."""
+    itemsize = spec.itemsize or 1
+    egress: Dict[int, float] = {}
+    ingress: Dict[int, float] = {}
+    total = 0.0
+    if broadcast:
+        routes = route_broadcast(spec, loadbalance)
+        seen = set()
+        for req in spec.requests:
+            gslices = spec.dst_tiles[req.dst_shard_index].slices
+            for ts in req.srcs:
+                key = (gslices, ts.tile.slices)
+                if key in seen:
+                    continue
+                seen.add(key)
+                b = ts.tile.size * itemsize
+                src_dev = spec.src_device_ids[ts.src_shard_index]
+                dst_dev = spec.dst_device_ids[routes[key]]
+                egress[src_dev] = egress.get(src_dev, 0.0) + b
+                ingress[dst_dev] = ingress.get(dst_dev, 0.0) + b
+                total += b
+    else:
+        for req in spec.requests:
+            dst_dev = spec.dst_device_ids[req.dst_shard_index]
+            for ts in req.srcs:
+                b = ts.tile.size * itemsize
+                src_dev = spec.src_device_ids[ts.src_shard_index]
+                egress[src_dev] = egress.get(src_dev, 0.0) + b
+                ingress[dst_dev] = ingress.get(dst_dev, 0.0) + b
+                total += b
+    links = list(egress.values()) + list(ingress.values())
+    return {
+        "egress": egress,
+        "ingress": ingress,
+        "total_bytes": total,
+        "max_link_bytes": max(links) if links else 0.0,
+    }
+
+
+def plan_send_order(spec: ReshardingTaskSpec
+                    ) -> Tuple[Tuple[int, int], ...]:
+    """Greedy send ordering: repeatedly issue the pending (request, src)
+    move whose SOURCE device has the least bytes already issued, so no
+    single egress link runs far ahead of the others early in the step
+    (the send-order half of arXiv:2211.05322's balancing; ties break by
+    plan order for determinism)."""
+    itemsize = spec.itemsize or 1
+    pending = [(ri, si) for ri, req in enumerate(spec.requests)
+               for si in range(len(req.srcs))]
+    issued: Dict[int, float] = {}
+    order: List[Tuple[int, int]] = []
+    while pending:
+        best = min(
+            pending,
+            key=lambda p: (issued.get(
+                spec.src_device_ids[
+                    spec.requests[p[0]].srcs[p[1]].src_shard_index],
+                0.0), p))
+        pending.remove(best)
+        ts = spec.requests[best[0]].srcs[best[1]]
+        dev = spec.src_device_ids[ts.src_shard_index]
+        issued[dev] = issued.get(dev, 0.0) + ts.tile.size * itemsize
+        order.append(best)
+    return tuple(order)
+
+
+# process-global planner counters, surfaced by monitoring (ISSUE 4)
+_planner_counters = {
+    "plans": 0,
+    "total_bytes": 0.0,
+    "broadcast_bytes": 0.0,
+    "max_link_bytes": 0.0,          # max over plans, balanced routing
+    "max_link_bytes_naive": 0.0,    # max over plans, naive routing
+}
+
+
+def _record_plan(spec: ReshardingTaskSpec):
+    c = _planner_counters
+    c["plans"] += 1
+    c["total_bytes"] += spec.transfer_bytes
+    c["broadcast_bytes"] += spec.broadcast_bytes
+    c["max_link_bytes"] = max(
+        c["max_link_bytes"], spec.max_link_bytes,
+        spec.max_link_bytes_broadcast)
+    c["max_link_bytes_naive"] = max(
+        c["max_link_bytes_naive"], spec.max_link_bytes_naive,
+        spec.max_link_bytes_broadcast_naive)
+
+
+def get_planner_stats() -> Dict[str, float]:
+    """Snapshot of the resharding planner counters (plans made, planned
+    total/broadcast bytes, max-link objective balanced vs naive)."""
+    return dict(_planner_counters)
+
+
+def reset_planner_stats():
+    for k in _planner_counters:
+        _planner_counters[k] = 0 if k == "plans" else 0.0
+
+
+def plan_resharding(shape: Tuple[int, ...],
+                    itemsize: int,
+                    src_sharding,
+                    dst_sharding,
+                    allow_allgather_rewrite: bool = True,
+                    loadbalance: Optional[bool] = None
+                    ) -> ReshardingTaskSpec:
+    """Compute the transfer plan for one cross-mesh value
+    (ref CrossMeshCommunicator._compile_resharding_specs:935).
+
+    ``loadbalance`` (default: from
+    ``global_config.resharding_loadbalance_mode``) selects balanced
+    source-holder selection, broadcast fan-out routing, and greedy send
+    ordering; off = first-holder / plan-order naive baseline.  Both
+    variants' max-link objectives are computed so reports can show the
+    balancing win without re-planning."""
+    if loadbalance is None:
+        from alpa_tpu.global_env import global_config
+        loadbalance = (getattr(global_config,
+                               "resharding_loadbalance_mode",
+                               "normal") != "no_loadbalance")
+    src_vda = VirtualDistributedArray.from_sharding(shape, src_sharding)
+    dst_vda = VirtualDistributedArray.from_sharding(shape, dst_sharding)
+
+    # Local-allgather rewrite (MLSys'23): if several destination shards
+    # request the SAME tile (dst replicates over some axis), fetching it
+    # once per replica wastes DCN.  Rewrite: each replica group member
+    # fetches a disjoint 1/k slice; the destination mesh all-gathers over
+    # ICI.  We mark the spec; the executor realizes the gather with a
+    # resharded device_put + with_sharding_constraint (XLA collective).
+    dst_unique = dst_vda.unique_tiles
+    replication = max(len(v) for v in dst_unique.values()) \
+        if dst_unique else 1
+    allgather_rewrite = allow_allgather_rewrite and replication > 1
+
+    requests, total = _build_requests(src_vda, dst_vda, itemsize,
+                                      allgather_rewrite, loadbalance)
+
+    spec = ReshardingTaskSpec(tuple(shape), requests, total,
                               allgather_rewrite,
                               src_device_ids=tuple(src_vda.device_ids),
                               dst_device_ids=tuple(dst_vda.device_ids),
                               src_tiles=tuple(src_vda.device_tiles),
-                              dst_tiles=tuple(dst_vda.device_tiles))
+                              dst_tiles=tuple(dst_vda.device_tiles),
+                              itemsize=itemsize,
+                              loadbalanced=bool(loadbalance))
+
+    # planner objective: max-link bytes under this plan's routing …
+    loads = compute_link_loads(spec, broadcast=False)
+    spec.max_link_bytes = loads["max_link_bytes"]
+    bloads = compute_link_loads(spec, broadcast=True,
+                                loadbalance=loadbalance)
+    spec.broadcast_bytes = bloads["total_bytes"]
+    spec.max_link_bytes_broadcast = bloads["max_link_bytes"]
+    # … and under the naive baseline (first-holder selection + routing),
+    # re-covered only when they can differ
+    if loadbalance:
+        nreq, _ = _build_requests(src_vda, dst_vda, itemsize,
+                                  allgather_rewrite, balance=False)
+        nspec = dataclasses.replace(spec, requests=nreq)
+        spec.max_link_bytes_naive = compute_link_loads(
+            nspec, broadcast=False)["max_link_bytes"]
+        spec.max_link_bytes_broadcast_naive = compute_link_loads(
+            nspec, broadcast=True, loadbalance=False)["max_link_bytes"]
+        spec.send_order = plan_send_order(spec)
+    else:
+        spec.max_link_bytes_naive = spec.max_link_bytes
+        spec.max_link_bytes_broadcast_naive = spec.max_link_bytes_broadcast
+    _record_plan(spec)
+    return spec
 
 
-def naive_transfer_bytes(shape, itemsize, dst_sharding) -> float:
-    """Bytes moved by the naive plan (full array to every dst shard's
-    needs without dedup/allgather) — for tests and reporting."""
+def naive_transfer_bytes(shape, itemsize, dst_sharding,
+                         mode: str = "send_recv") -> float:
+    """Bytes moved by the naive plan (no dedup/allgather) — for tests and
+    reporting.
+
+    ``mode="send_recv"``: the full per-shard need of every destination
+    shard crosses (a replicated destination pays once PER REPLICA).
+    ``mode="broadcast"``: each unique destination tile crosses exactly
+    once regardless of replication — the correct baseline for
+    broadcast-mode execution, where counting per replica overstates the
+    wire bytes k-fold (ISSUE 4 accounting audit)."""
     vda = VirtualDistributedArray.from_sharding(shape, dst_sharding)
+    if mode == "broadcast":
+        return float(sum(Tile(sl).size
+                         for sl in vda.unique_tiles)) * itemsize
+    if mode != "send_recv":
+        raise ValueError(f"unknown naive_transfer_bytes mode: {mode}")
     return float(sum(t.size for t in vda.device_tiles)) * itemsize
 
 
@@ -261,6 +508,29 @@ def shard_structures_match(shape, src_sharding, dst_sharding) -> bool:
     except Exception:  # pylint: disable=broad-except
         return False
     return list(src_map.values()) == list(dst_map.values())
+
+
+def _apply_sync_semantics(out):
+    """Blocking-transfer emulation (ISSUE 4 benchmark support).
+
+    The CPU test backend's shard moves are asynchronous in-process
+    memcpys, so a RESHARD never blocks the thread that issued it —
+    unlike multi-host send/recv, which blocks for producer readiness
+    plus wire latency.  With ``sync_resharding_transfers`` the calling
+    thread blocks until the destination arrays materialize; with
+    ``resharding_transfer_latency_s`` it additionally idles for the
+    emulated wire time.  Both default off and cost one attribute read
+    per transfer call.
+    """
+    from alpa_tpu.global_env import global_config
+    lat = global_config.resharding_transfer_latency_s
+    if lat or global_config.sync_resharding_transfers:
+        import time as _time
+
+        import jax
+        jax.block_until_ready(out)
+        if lat:
+            _time.sleep(lat)
 
 
 class DirectTransfer:
@@ -304,18 +574,22 @@ class DirectTransfer:
                 self.fast = False
 
     def __call__(self, val):
+        out = None
         if self.fast:
             try:
                 if val.sharding.is_equivalent_to(self.src_sharding,
                                                  self.ndim):
                     import jaxlib.xla_extension as xe
-                    return xe.batched_copy_array_to_devices_with_sharding(
+                    out = xe.batched_copy_array_to_devices_with_sharding(
                         [val], [self._dst_devices], [self.dst_sharding],
                         [self._semantics])[0]
             except Exception:  # pylint: disable=broad-except
-                pass
-        import jax
-        return jax.device_put(val, self.dst_sharding)
+                out = None
+        if out is None:
+            import jax
+            out = jax.device_put(val, self.dst_sharding)
+        _apply_sync_semantics(out)
+        return out
 
 
 class DirectTransferGroup:
@@ -336,19 +610,24 @@ class DirectTransferGroup:
 
     def __call__(self, vals):
         ts = self.transfers
+        out = None
         if self.all_fast:
             try:
                 if all(v.sharding.is_equivalent_to(t.src_sharding, t.ndim)
                        for v, t in zip(vals, ts)):
                     import jaxlib.xla_extension as xe
-                    return xe.batched_copy_array_to_devices_with_sharding(
+                    out = xe.batched_copy_array_to_devices_with_sharding(
                         list(vals), [t._dst_devices for t in ts],
                         [t.dst_sharding for t in ts],
                         [t._semantics for t in ts])
             except Exception:  # pylint: disable=broad-except
-                pass
-        import jax
-        return jax.device_put(list(vals), [t.dst_sharding for t in ts])
+                out = None
+        if out is None:
+            import jax
+            out = jax.device_put(list(vals), [t.dst_sharding for t in ts])
+        # one emulated wire round-trip for the whole coalesced message
+        _apply_sync_semantics(out)
+        return out
 
 
 @dataclasses.dataclass
@@ -364,12 +643,16 @@ class ExecutionReport:
     (bf16/fp16 -> f32, bool -> i32) — up to 4x the planned bytes for
     sub-word payloads.  It is per-process payload size, not a total-DCN
     measurement (the collective also carries each non-owner process's
-    zero slots), and only ``run_multiprocess`` sets it."""
+    zero slots), and only ``run_multiprocess`` sets it.
+    ``max_link_bytes`` (ISSUE 4) is the busiest single link this run
+    loaded — max over per-source-device egress and per-destination-device
+    ingress bytes of the cross-mesh leg."""
     mode: str = "device_put"
     cross_mesh_bytes: float = 0.0
     intra_mesh_bytes: float = 0.0
     wire_bytes: float = 0.0
     n_tiles: int = 0
+    max_link_bytes: float = 0.0
 
 
 class ReshardingTask:
@@ -501,6 +784,14 @@ class ReshardingTask:
         report.cross_mesh_bytes = float(total) * dtype.itemsize
         report.wire_bytes = float(total) * np.dtype(work).itemsize
         report.n_tiles = len(order)
+        # busiest egress link: bytes painted per owning source device
+        # (ingress is collective — every process receives the full pack)
+        egress: Dict[int, float] = {}
+        for ts in order:
+            dev_id = spec.src_device_ids[ts.src_shard_index]
+            egress[dev_id] = (egress.get(dev_id, 0.0) +
+                              ts.tile.size * dtype.itemsize)
+        report.max_link_bytes = max(egress.values()) if egress else 0.0
 
         # local assembly: every locally-addressable destination shard
         # fills its full tile from the intersecting packed tiles
@@ -564,28 +855,49 @@ class ReshardingTask:
             spec.shape, list(spec.dst_tiles),
             list(spec.dst_device_ids)).unique_tiles
 
-        # 1) cross-mesh leg: move each planned TileSlice to one dst device.
+        # 1) cross-mesh leg: move each planned TileSlice to one dst
+        #    device, in the planner's balanced send order (ISSUE 4).
+        #    Broadcast mode routes each replica group's unique tiles
+        #    across the group members (route_broadcast) — naive routing
+        #    piles the whole group's ingress on the first holder —
+        #    and each unique piece still crosses exactly once; the other
+        #    holders are served by intra-mesh fan-out below.
         #    landed[shard_index] = [(global_tile, piece_on_dst_device)]
         landed: Dict[int, List[Tuple[Tile, Any]]] = {}
-        seen_at: Dict[int, set] = {}
-        for req in spec.requests:
-            holders = groups[spec.dst_tiles[req.dst_shard_index].slices]
-            # broadcast mode: every cross-mesh fetch of a replica group is
-            # routed to the group's first holder (each unique piece crosses
-            # once); other holders are served by intra-mesh fan-out below.
-            target = holders[0] if broadcast else req.dst_shard_index
-            dst_dev = dev_by_id[spec.dst_device_ids[target]]
-            for ts in req.srcs:
-                if ts.tile.slices in seen_at.setdefault(target, set()):
+        routes = route_broadcast(spec, spec.loadbalanced) \
+            if broadcast else None
+        seen: set = set()
+        egress: Dict[int, float] = {}
+        ingress: Dict[int, float] = {}
+        order = spec.send_order or tuple(
+            (ri, si) for ri, req in enumerate(spec.requests)
+            for si in range(len(req.srcs)))
+        for ri, si in order:
+            req = spec.requests[ri]
+            ts = req.srcs[si]
+            if broadcast:
+                gslices = spec.dst_tiles[req.dst_shard_index].slices
+                key = (gslices, ts.tile.slices)
+                if key in seen:
                     continue
-                seen_at[target].add(ts.tile.slices)
-                shard = src_data[spec.src_device_ids[ts.src_shard_index]]
-                piece = shard[tuple(slice(a, b)
-                                    for a, b in ts.offset_in_src)]
-                moved = jax.device_put(piece, dst_dev)
-                report.cross_mesh_bytes += ts.tile.size * itemsize
-                report.n_tiles += 1
-                landed.setdefault(target, []).append((ts.tile, moved))
+                seen.add(key)
+                target = routes[key]
+            else:
+                target = req.dst_shard_index
+            dst_dev_id = spec.dst_device_ids[target]
+            src_dev_id = spec.src_device_ids[ts.src_shard_index]
+            shard = src_data[src_dev_id]
+            piece = shard[tuple(slice(a, b)
+                                for a, b in ts.offset_in_src)]
+            moved = jax.device_put(piece, dev_by_id[dst_dev_id])
+            nbytes = ts.tile.size * itemsize
+            report.cross_mesh_bytes += nbytes
+            egress[src_dev_id] = egress.get(src_dev_id, 0.0) + nbytes
+            ingress[dst_dev_id] = ingress.get(dst_dev_id, 0.0) + nbytes
+            report.n_tiles += 1
+            landed.setdefault(target, []).append((ts.tile, moved))
+        links = list(egress.values()) + list(ingress.values())
+        report.max_link_bytes = max(links) if links else 0.0
 
         # 2) intra-mesh leg + assembly: every dst shard assembles its FULL
         #    tile; pieces that landed on a sibling replica are pulled over

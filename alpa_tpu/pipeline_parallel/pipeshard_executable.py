@@ -342,6 +342,9 @@ class PipeshardDriverExecutable:
         self._resharding_bytes = 0.0
         self._executed_resharding_bytes = 0.0
         self._executed_intra_mesh_bytes = 0.0
+        # max per-link (per-device egress/ingress) bytes over all planned
+        # cross-mesh transfers — the ISSUE 4 planner objective
+        self._max_link_bytes = 0.0
         ginvar_idx = {v: i for i, v in enumerate(self.global_invars)}
         batch_var = {
             v for v, b in zip(self.global_invars, self.batch_invars) if b
@@ -435,6 +438,9 @@ class PipeshardDriverExecutable:
                             tuple(v.aval.shape), v.aval.dtype.itemsize,
                             src_sh, dst_sharding)
                         self._resharding_bytes += inst.plan.transfer_bytes
+                        self._max_link_bytes = max(
+                            self._max_link_bytes, inst.plan.max_link_bytes,
+                            inst.plan.max_link_bytes_broadcast)
                         # pre-built, reusable executor: planned execution
                         # modes replay this task every step instead of
                         # re-resolving it on the hot path
@@ -554,8 +560,15 @@ class PipeshardDriverExecutable:
         self._const_cache = None
         self._zero_exec_cache = None
         # register-file replay fast path (built lazily on first eligible
-        # launch; see _ensure_lowered)
-        self._register_program = None
+        # launch; see _ensure_lowered).  _register_programs maps lowering
+        # mode ("registers" | "overlap") -> RegisterFileProgram; the two
+        # modes share identical slot numbering (phase-1 lowering is
+        # mode-independent) so the launch-time slot tables are built once.
+        self._register_programs = {}
+        self._register_program = None   # the "registers" program (tests)
+        self._has_cross_mesh = any(
+            i.opcode == PipelineInstType.RESHARD and
+            i.src_mesh != i.dst_mesh for i in self.instructions)
         self._reg_input_loads = None
         self._reg_const_loads = None
         self._reg_acc_slots = None
@@ -632,16 +645,31 @@ class PipeshardDriverExecutable:
                   not fault.instrumented() and
                   not global_config.collect_trace and
                   not global_config.debug_dispatch_races)
-        if dmode == "registers" and not reg_ok and \
+        if dmode in ("registers", "overlap") and not reg_ok and \
                 not self._warned_register_fallback:
             self._warned_register_fallback = True
             logger.warning(
-                "pipeline_dispatch_mode='registers' requested but the "
+                "pipeline_dispatch_mode=%r requested but the "
                 "launch is not eligible (multiprocess, planned resharding, "
                 "fault/trace/race instrumentation); falling back to the "
-                "instruction interpreter")
-        if reg_ok and dmode in ("auto", "registers"):
-            return self._launch_registers(flat_args)
+                "instruction interpreter", dmode)
+        # overlap mode (ISSUE 4): replay the dataflow graph with eager
+        # async cross-mesh transfers.  Eligible when the register path is
+        # eligible AND there is actual cross-mesh traffic to overlap.
+        overlap_ok = (reg_ok and self.num_meshes > 1 and
+                      self._has_cross_mesh and
+                      getattr(global_config, "overlap_resharding", True))
+        if dmode == "overlap" and reg_ok and not overlap_ok and \
+                not self._warned_register_fallback:
+            self._warned_register_fallback = True
+            logger.warning(
+                "pipeline_dispatch_mode='overlap' requested but there is "
+                "nothing to overlap (single mesh, no cross-mesh RESHARDs, "
+                "or overlap_resharding disabled); using register dispatch")
+        if reg_ok and dmode in ("auto", "registers", "overlap"):
+            use_overlap = overlap_ok and dmode in ("auto", "overlap")
+            return self._launch_registers(
+                flat_args, mode="overlap" if use_overlap else "registers")
         # multiprocess + "planned": cross-process RESHARD instructions
         # drive the tile plan via ReshardingTask.run_multiprocess (packed
         # tiles cross the boundary, not a full-array gather); everything
@@ -801,13 +829,25 @@ class PipeshardDriverExecutable:
     # ------------------------------------------------------------------
     # register-file replay fast path (ISSUE 2)
     # ------------------------------------------------------------------
-    def _ensure_lowered(self):
-        """Lower the instruction list into a RegisterFileProgram (once)
-        and precompute the launch-time slot tables: input loads, const
-        loads, accumulator slots, and output slots — so the replay loop
-        touches only integer-indexed lists."""
-        if self._register_program is not None:
-            return self._register_program
+    def _overlap_window(self) -> int:
+        """The in-flight transfer window for overlap lowering: the
+        explicit knob when set, otherwise the schedule's hint."""
+        w = int(getattr(global_config, "overlap_inflight_window", 0) or 0)
+        if w > 0:
+            return w
+        hint = getattr(self.schedule, "overlap_window_hint", None)
+        return int(hint()) if callable(hint) else max(2, self.num_meshes)
+
+    def _ensure_lowered(self, mode: str = "registers"):
+        """Lower the instruction list into a RegisterFileProgram (once
+        per mode) and precompute the launch-time slot tables: input
+        loads, const loads, accumulator slots, and output slots — so the
+        replay loop touches only integer-indexed lists.  Phase-1 lowering
+        is mode-independent, so every mode's program has identical
+        ``slot_of`` and the slot tables are shared."""
+        prog = self._register_programs.get(mode)
+        if prog is not None:
+            return prog
         from alpa_tpu.pipeline_parallel.runtime_emitter import (
             lower_to_register_file)
         n_mb = self.num_micro_batches
@@ -829,8 +869,15 @@ class PipeshardDriverExecutable:
         for v, mesh_id, _aval, sh in self.acc_allocs:
             preplaced[(v, -1, mesh_id)] = sh
 
-        prog = lower_to_register_file(self.instructions, preplaced)
+        prog = lower_to_register_file(self.instructions, preplaced,
+                                      mode=mode,
+                                      overlap_window=self._overlap_window())
+        self._register_programs[mode] = prog
+        if mode == "registers":
+            self._register_program = prog
         slot_of = prog.slot_of
+        if self._reg_input_loads is not None:
+            return prog
 
         # input placement: (flat arg index, is_batch, [(slot, sharding,
         # microbatch)]) — resolved once, replayed every launch
@@ -863,14 +910,15 @@ class PipeshardDriverExecutable:
                     ("concat", ([slot_of[(v, mb, m)] for mb, m in meshes],
                                 meshes)))
         self._reg_output_specs = out_specs
-        self._register_program = prog
         return prog
 
-    def _launch_registers(self, flat_args):
+    def _launch_registers(self, flat_args, mode: str = "registers"):
         """Replay the lowered register-file program: flat list reads and
         writes only — the per-instruction driver cost is the compiled
-        executables' C++ dispatch plus the pre-resolved transfers."""
-        prog = self._ensure_lowered()
+        executables' C++ dispatch plus the pre-resolved transfers.  In
+        ``overlap`` mode the program is the dataflow-graph replay with
+        eager async cross-mesh transfers (ISSUE 4)."""
+        prog = self._ensure_lowered(mode)
         regs: List[Any] = [None] * prog.num_slots
         n_mb = self.num_micro_batches
 
@@ -934,10 +982,27 @@ class PipeshardDriverExecutable:
             "n_ops": len(prog.ops),
             "loop_s": loop_s,
             "per_inst_us": loop_s / n_inst * 1e6,
-            "mode": "registers",
+            "mode": prog.mode,
             "by_opcode": {k: {"n": v, "s": 0.0}
                           for k, v in prog.by_opcode.items()},
         }
+        if prog.mode == "overlap":
+            busy = prog.run_stats["transfer_busy_s"]
+            blocked = prog.run_stats["wait_blocked_s"]
+            frac = max(0.0, min(1.0, 1.0 - blocked / busy)) if busy > 0 \
+                else 1.0
+            self.last_dispatch_stats.update(
+                n_cross_mesh=prog.n_cross_mesh,
+                n_hoisted=prog.n_hoisted,
+                n_launches=prog.n_launches,
+                overlap_window=prog.overlap_window,
+                transfer_busy_s=busy,
+                wait_blocked_s=blocked,
+                overlap_fraction=frac,
+            )
+            from alpa_tpu.pipeline_parallel.runtime_emitter import (
+                record_overlap_step)
+            record_overlap_step(self.last_dispatch_stats)
 
         # collect outputs
         outs = []
@@ -1200,6 +1265,9 @@ class PipeshardDriverExecutable:
                 i.src_mesh != i.dst_mesh)
         report = (f"{n} cross-mesh transfers, "
                   f"{self._resharding_bytes / 1e6:.3f} MB per step (planned)")
+        if self._max_link_bytes:
+            report += (f"; max link {self._max_link_bytes / 1e6:.3f} MB "
+                       f"(per-device egress/ingress)")
         if self._executed_resharding_bytes:
             report += (
                 f"; executed {self._executed_resharding_bytes / 1e6:.3f} MB "

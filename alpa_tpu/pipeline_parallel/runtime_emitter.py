@@ -9,7 +9,12 @@ instruction list.  Single-controller simplifications vs the reference:
   over ICI/DCN; ref cross_mesh_resharding's NCCL P2P machinery becomes the
   runtime's transfer engine).
 * There is one global instruction stream instead of per-host worker
-  streams; jax's async dispatch provides cross-mesh overlap.
+  streams; cross-mesh overlap is explicit (ISSUE 4): the lowering builds
+  an instruction-level dataflow graph over register slots and the
+  ``overlap`` dispatch mode replays it with cross-mesh RESHARDs launched
+  eagerly on a transfer pool the moment their producers retire, bounded
+  by an in-flight window (jax's async dispatch remains the fallback
+  overlap story for the interpreter modes).
 * ``FREE`` is emitted from liveness analysis like the reference
   (``_compile_free``, ref runtime_emitter.py:1087) and drops env references
   so buffers are reclaimed promptly.
@@ -20,7 +25,10 @@ accumulators, apply-grad results).
 """
 import dataclasses
 import enum
+import heapq
 import logging
+import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from jax.extend.core import Var
@@ -258,6 +266,177 @@ class DispatchRaceChecker:
 
 
 ########################################
+# instruction dataflow graph (ISSUE 4 tentpole)
+########################################
+
+
+@dataclasses.dataclass
+class DataflowNode:
+    """One lowered instruction's register-slot footprint."""
+    idx: int                            # flat (emitted) instruction index
+    kind: str                           # "RUN" | "RESHARD" | "FREE"
+    reads: Tuple[int, ...] = ()
+    writes: Tuple[int, ...] = ()
+    kills: Tuple[int, ...] = ()         # donation / FREE targets
+    edge: Optional[Tuple[int, int]] = None  # RESHARD (src_mesh, dst_mesh)
+    cross_mesh: bool = False
+    info: str = ""
+
+
+@dataclasses.dataclass
+class InstructionDataflowGraph:
+    """Explicit producer/consumer edges over register slots, built at
+    lowering time for every instruction (ISSUE 4).
+
+    Edge kinds:
+
+    * RAW — a reader depends on the last writer of every slot it reads.
+    * WAW/WAR and kill — a writer or killer depends on the previous
+      writer AND on every reader since.  Donation (``donate_argnums`` on
+      accumulator inputs) and FREE are kills: they invalidate the buffer,
+      so an eagerly launched transfer reading the slot must retire before
+      the donating RUN or the FREE executes.
+
+    Every edge points to an earlier flat index, so any replay that
+    respects ``preds`` is deadlock-free by construction.  The ``overlap``
+    dispatch mode replays this graph instead of the flat list; the fuzz
+    test in tests/runtime/test_overlap_dispatch.py drives randomized
+    topologies through :func:`schedule_overlap` and checks the replay
+    invariants directly.
+    """
+    nodes: List[DataflowNode]
+    preds: List[Tuple[int, ...]]
+    succs: List[Tuple[int, ...]]
+
+    @classmethod
+    def build(cls, nodes: Sequence[DataflowNode]
+              ) -> "InstructionDataflowGraph":
+        last_writer: Dict[int, int] = {}
+        readers_since: Dict[int, List[int]] = {}
+        preds: List[set] = [set() for _ in nodes]
+        for node in nodes:
+            i = node.idx
+            for s in node.reads:
+                w = last_writer.get(s)
+                if w is not None and w != i:
+                    preds[i].add(w)
+                readers_since.setdefault(s, []).append(i)
+            for s in tuple(node.writes) + tuple(node.kills):
+                w = last_writer.get(s)
+                if w is not None and w != i:
+                    preds[i].add(w)
+                for r in readers_since.get(s, ()):
+                    if r != i:
+                        preds[i].add(r)
+                readers_since[s] = []
+                last_writer[s] = i
+        succs: List[set] = [set() for _ in nodes]
+        for i, ps in enumerate(preds):
+            for p in ps:
+                succs[p].add(i)
+        return cls(list(nodes),
+                   [tuple(sorted(p)) for p in preds],
+                   [tuple(sorted(s)) for s in succs])
+
+    @property
+    def n_cross_mesh(self) -> int:
+        return sum(1 for n_ in self.nodes if n_.cross_mesh)
+
+
+def schedule_overlap(graph: InstructionDataflowGraph, window: int
+                     ) -> Tuple[List[Tuple[str, int]], int]:
+    """Greedy overlap schedule: replay the dataflow graph with cross-mesh
+    RESHARDs hoisted and launched eagerly the moment their producers
+    retire, bounded by an in-flight-transfer ``window`` (caps host/staging
+    memory: at most ``window`` launched-but-unwaited transfers exist).
+
+    Returns ``(plan, n_hoisted)`` where ``plan`` is a list of
+    ``("exec" | "launch" | "wait", node_idx)`` issue steps and
+    ``n_hoisted`` counts transfers launched before their flat position.
+
+    Invariants (held by construction, asserted by the fuzz test):
+
+    * every node appears exactly once as exec or launch, and every
+      launch has exactly one later wait;
+    * a node issues only after ALL its graph predecessors retired
+      (exec'd, or waited for transfers) — no op reads a slot before its
+      producer transfer lands, and no donation/FREE fires while a
+      transfer still uses the slot;
+    * non-transfer ops keep their flat relative order, so the schedule
+      is the flat order with transfers slid earlier (launch) and their
+      completion points slid as late as the first dependent allows;
+    * at most ``window`` transfers are in flight at any step.
+    """
+    nodes = graph.nodes
+    n = len(nodes)
+    window = max(1, int(window))
+    unmet = [len(graph.preds[i]) for i in range(n)]
+    issued = [False] * n
+    retired = [False] * n
+    inflight: List[int] = []            # launch order (FIFO)
+    ready: List[int] = []               # min-heap of launchable transfers
+    plan: List[Tuple[str, int]] = []
+    n_hoisted = 0
+
+    def retire(i):
+        retired[i] = True
+        for s in graph.succs[i]:
+            unmet[s] -= 1
+            if unmet[s] == 0 and nodes[s].cross_mesh and not issued[s]:
+                heapq.heappush(ready, s)
+
+    def wait(i):
+        plan.append(("wait", i))
+        inflight.remove(i)
+        retire(i)
+
+    def launch(i, cur):
+        nonlocal n_hoisted
+        plan.append(("launch", i))
+        issued[i] = True
+        inflight.append(i)
+        if i > cur:
+            n_hoisted += 1
+
+    def pump(cur):
+        while ready and len(inflight) < window:
+            i = heapq.heappop(ready)
+            if not issued[i]:
+                launch(i, cur)
+
+    for i in range(n):
+        if unmet[i] == 0 and nodes[i].cross_mesh:
+            heapq.heappush(ready, i)
+    pump(-1)
+
+    for cur in range(n):
+        node = nodes[cur]
+        if node.cross_mesh:
+            if not issued[cur]:
+                # make room, then settle any in-flight transfer this one
+                # chains on (e.g. multi-hop reshard of the same value)
+                while len(inflight) >= window:
+                    wait(inflight[0])
+                for p in graph.preds[cur]:
+                    if not retired[p]:
+                        wait(p)
+                launch(cur, cur)
+            pump(cur)
+            continue
+        # non-transfer op: settle exactly the transfers it depends on
+        for p in graph.preds[cur]:
+            if not retired[p]:
+                wait(p)
+        plan.append(("exec", cur))
+        issued[cur] = True
+        retire(cur)
+        pump(cur)
+    while inflight:
+        wait(inflight[0])
+    return plan, n_hoisted
+
+
+########################################
 # register-file lowering (replay fast path)
 ########################################
 
@@ -292,8 +471,22 @@ class RegisterFileProgram:
     n_coalesced_groups: int
     n_fixups: int
     text: str                           # one line per op, for fingerprints
+    # --- ISSUE 4: dataflow graph + overlap mode ---
+    mode: str = "registers"
+    graph: Optional[InstructionDataflowGraph] = None
+    n_cross_mesh: int = 0               # cross-mesh RESHARDs in the list
+    n_hoisted: int = 0                  # transfers launched before flat pos
+    n_launches: int = 0                 # async launch ops (groups count 1)
+    n_free_hops: int = 0                # FREEs hopped by extended coalescing
+    overlap_window: int = 0             # in-flight window (overlap mode)
+    run_stats: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: {"transfer_busy_s": 0.0,
+                                 "wait_blocked_s": 0.0})
 
     def execute(self, regs: List[Any]):
+        rs = self.run_stats
+        rs["transfer_busy_s"] = 0.0
+        rs["wait_blocked_s"] = 0.0
         for op in self.ops:
             op(regs)
 
@@ -354,9 +547,138 @@ def _make_free_op(slots):
     return op
 
 
+########################################
+# overlap mode: async transfer launch/wait ops (ISSUE 4)
+########################################
+
+_TRANSFER_POOL = None
+_TRANSFER_POOL_LOCK = threading.Lock()
+
+
+def _transfer_pool():
+    """Process-wide transfer thread pool shared by every overlap-mode
+    program (the scheduler's in-flight window — not the pool size — is
+    what bounds concurrent transfers and staging memory)."""
+    global _TRANSFER_POOL
+    if _TRANSFER_POOL is None:
+        with _TRANSFER_POOL_LOCK:
+            if _TRANSFER_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+                _TRANSFER_POOL = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="alpa-overlap")
+    return _TRANSFER_POOL
+
+
+class _PendingTransfer:
+    """A launched-but-unwaited cross-mesh transfer, parked in its dst
+    slot until the matching wait op resolves it.  The dataflow graph
+    guarantees nothing reads the slot in between."""
+    __slots__ = ("future",)
+
+    def __init__(self, future):
+        self.future = future
+
+
+def _make_launch_op(transfer, src_slot, dst_slot):
+    # regs[src] is captured on the driver thread at launch time, so a
+    # later donation/FREE of the src slot (which the schedule orders
+    # after this launch's wait anyway) can never race the worker.
+    def op(regs, _t=transfer, _s=src_slot, _d=dst_slot):
+        v = regs[_s]
+
+        def work(_v=v, _tt=_t):
+            t0 = time.perf_counter()
+            out = _tt(_v)
+            return out, time.perf_counter() - t0
+
+        regs[_d] = _PendingTransfer(_transfer_pool().submit(work))
+
+    return op
+
+
+def _make_wait_op(dst_slot, stats):
+    def op(regs, _d=dst_slot, _st=stats):
+        p = regs[_d]
+        if type(p) is _PendingTransfer:
+            t0 = time.perf_counter()
+            out, busy = p.future.result()
+            _st["wait_blocked_s"] += time.perf_counter() - t0
+            _st["transfer_busy_s"] += busy
+            regs[_d] = out
+
+    return op
+
+
+def _make_launch_group_op(group, src_slots, dst_slots):
+    # The whole batched group travels as one future, parked at the first
+    # member's dst slot; the group wait scatters every output.
+    def op(regs, _g=group, _s=src_slots, _d=dst_slots):
+        vals = [regs[s] for s in _s]
+
+        def work(_v=vals, _gg=_g):
+            t0 = time.perf_counter()
+            outs = _gg(_v)
+            return outs, time.perf_counter() - t0
+
+        regs[_d[0]] = _PendingTransfer(_transfer_pool().submit(work))
+
+    return op
+
+
+def _make_wait_group_op(dst_slots, stats):
+    def op(regs, _d=dst_slots, _st=stats):
+        p = regs[_d[0]]
+        if type(p) is _PendingTransfer:
+            t0 = time.perf_counter()
+            outs, busy = p.future.result()
+            _st["wait_blocked_s"] += time.perf_counter() - t0
+            _st["transfer_busy_s"] += busy
+            for d, o in zip(_d, outs):
+                regs[d] = o
+
+    return op
+
+
+# process-wide overlap runtime counters (surfaced via monitoring)
+_overlap_totals = {
+    "steps": 0,
+    "transfer_busy_s": 0.0,
+    "wait_blocked_s": 0.0,
+    "n_hoisted": 0,
+    "n_launches": 0,
+    "last_overlap_fraction": 0.0,
+    "last_window": 0,
+}
+
+
+def record_overlap_step(stats: Dict[str, Any]) -> None:
+    """Fold one overlap-mode step's dispatch stats into the process-wide
+    counters (called by pipeshard_executable after each launch)."""
+    _overlap_totals["steps"] += 1
+    _overlap_totals["transfer_busy_s"] += stats.get("transfer_busy_s", 0.0)
+    _overlap_totals["wait_blocked_s"] += stats.get("wait_blocked_s", 0.0)
+    _overlap_totals["n_hoisted"] += stats.get("n_hoisted", 0)
+    _overlap_totals["n_launches"] += stats.get("n_launches", 0)
+    _overlap_totals["last_overlap_fraction"] = stats.get(
+        "overlap_fraction", 0.0)
+    _overlap_totals["last_window"] = stats.get("overlap_window", 0)
+
+
+def get_overlap_runtime_stats() -> Dict[str, Any]:
+    return dict(_overlap_totals)
+
+
+def reset_overlap_runtime_stats() -> None:
+    _overlap_totals.update(steps=0, transfer_busy_s=0.0, wait_blocked_s=0.0,
+                           n_hoisted=0, n_launches=0,
+                           last_overlap_fraction=0.0, last_window=0)
+
+
 def lower_to_register_file(
         instructions: List[PipelineInstruction],
-        preplaced_shardings: Dict[Tuple[Var, int, int], Any]
+        preplaced_shardings: Dict[Tuple[Var, int, int], Any],
+        mode: str = "registers",
+        overlap_window: int = 4,
 ) -> RegisterFileProgram:
     """Lower the emitted instruction list into a :class:`RegisterFileProgram`.
 
@@ -367,9 +689,31 @@ def lower_to_register_file(
     holds, so RESHARD executors know their source sharding statically and
     RUN args that would need the interpreter's per-call relayout safety
     net become precomputed fixups.
+
+    Two phases (ISSUE 4).  Phase 1 is mode-independent: slot allocation,
+    static sharding propagation, and the per-instruction dataflow graph
+    are identical for every ``mode``, so programs lowered from the same
+    instruction list share ``slot_of`` and the launch-time slot tables
+    can be reused across modes.  Phase 2 emits ops per mode:
+
+    * ``registers`` — flat instruction order, with same-edge RESHARD
+      coalescing extended past intervening FREEs (PR 2's pass required
+      global adjacency, but FREEs emitted right after a value's last use
+      split otherwise-contiguous same-edge runs).  Hopping a FREE is safe
+      because FREE always follows its slots' last use — the batched group
+      runs first and the FREE is re-emitted right after it; a same-edge
+      RESHARD touching a hopped slot ends the group instead of joining.
+    * ``overlap`` — replay :func:`schedule_overlap`'s plan: cross-mesh
+      RESHARDs become launch/wait pairs over a shared transfer thread
+      pool with a bounded in-flight window, and consecutive same-edge
+      launches merge into one batched group launch.  Same-mesh relayouts
+      and everything else execute synchronously in flat relative order.
     """
     from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
         DirectTransfer, DirectTransferGroup)
+
+    if mode not in ("registers", "overlap"):
+        raise ValueError(f"unknown lowering mode: {mode!r}")
 
     slot_of: Dict[Tuple[Var, int, int], int] = {}
 
@@ -383,16 +727,12 @@ def lower_to_register_file(
     for key, sh in preplaced_shardings.items():
         cur_sharding[slot(key)] = sh
 
-    ops: List[Any] = []
-    lines: List[str] = []
+    # ---- phase 1: slot allocation + sharding propagation (mode-free) ----
+    recs: List[Dict[str, Any]] = []
     by_opcode = {"RUN": 0, "RESHARD": 0, "FREE": 0}
-    n_groups = 0
     n_fixups = 0
 
-    i = 0
-    n = len(instructions)
-    while i < n:
-        inst = instructions[i]
+    for inst in instructions:
         if inst.opcode == PipelineInstType.RUN:
             by_opcode["RUN"] += 1
             ex = inst.executable
@@ -410,57 +750,191 @@ def lower_to_register_file(
                 out_slots.append(s)
                 cur_sharding[s] = ex.out_shardings[pos]
             n_fixups += len(fixups)
-            ops.append(
-                _make_run_op(ex.compiled, tuple(in_slots), tuple(out_slots),
-                             tuple(fixups)))
-            lines.append(f"RUN {inst.info} mb={inst.micro_batch} "
+            donated = set(getattr(ex, "donate_idx", ()) or ())
+            kills = tuple(sorted({in_slots[p] for p in donated
+                                  if p < len(in_slots)}))
+            recs.append({
+                "kind": "RUN",
+                "op": _make_run_op(ex.compiled, tuple(in_slots),
+                                   tuple(out_slots), tuple(fixups)),
+                "reads": tuple(in_slots),
+                "writes": tuple(out_slots),
+                "kills": kills,
+                "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "
-                         f"fix={[(p, str(s)) for p, s, _ in fixups]}")
-            i += 1
+                         f"fix={[(p, str(s)) for p, s, _ in fixups]}"),
+            })
         elif inst.opcode == PipelineInstType.RESHARD:
-            # coalesce the maximal run of globally-adjacent RESHARDs on
-            # the same (src, dst) edge into one batched transfer
-            edge = (inst.src_mesh, inst.dst_mesh)
-            j = i
-            group: List[PipelineInstruction] = []
-            while (j < n and
-                   instructions[j].opcode == PipelineInstType.RESHARD and
-                   (instructions[j].src_mesh,
-                    instructions[j].dst_mesh) == edge):
-                group.append(instructions[j])
-                j += 1
-            src_slots, dst_slots, transfers = [], [], []
-            for g in group:
-                by_opcode["RESHARD"] += 1
-                v = g.var_key[0]
-                ss = slot((v, g.var_key[1], g.src_mesh))
-                ds = slot((v, g.var_key[1], g.dst_mesh))
-                t = DirectTransfer(v.aval, cur_sharding.get(ss),
-                                   g.dst_sharding)
-                src_slots.append(ss)
-                dst_slots.append(ds)
-                transfers.append(t)
-                cur_sharding[ds] = g.dst_sharding
-                lines.append(f"RESHARD {g.var_key} {g.src_mesh}->"
-                             f"{g.dst_mesh} slot {ss}->{ds} "
-                             f"fast={t.fast} edgegroup={len(group)}")
-            if len(group) == 1:
-                ops.append(
-                    _make_reshard_op(transfers[0], src_slots[0],
-                                     dst_slots[0]))
-            else:
-                n_groups += 1
-                ops.append(
-                    _make_reshard_group_op(DirectTransferGroup(transfers),
-                                           tuple(src_slots),
-                                           tuple(dst_slots)))
-            i = j
+            by_opcode["RESHARD"] += 1
+            v = inst.var_key[0]
+            ss = slot((v, inst.var_key[1], inst.src_mesh))
+            ds = slot((v, inst.var_key[1], inst.dst_mesh))
+            t = DirectTransfer(v.aval, cur_sharding.get(ss),
+                               inst.dst_sharding)
+            cur_sharding[ds] = inst.dst_sharding
+            recs.append({
+                "kind": "RESHARD",
+                "op": _make_reshard_op(t, ss, ds),
+                "transfer": t,
+                "ss": ss,
+                "ds": ds,
+                "edge": (inst.src_mesh, inst.dst_mesh),
+                "cross": inst.src_mesh != inst.dst_mesh,
+                "reads": (ss,),
+                "writes": (ds,),
+                "kills": (),
+                "line": (f"RESHARD {inst.var_key} {inst.src_mesh}->"
+                         f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}"),
+            })
         else:  # FREE
             by_opcode["FREE"] += 1
             slots = tuple(slot((k[0], k[1], k[2])) for k in inst.free_keys)
-            ops.append(_make_free_op(slots))
-            lines.append(f"FREE {list(slots)}")
-            i += 1
+            recs.append({
+                "kind": "FREE",
+                "op": _make_free_op(slots),
+                "slots": slots,
+                "reads": (),
+                "writes": (),
+                "kills": slots,
+                "line": f"FREE {list(slots)}",
+            })
+
+    nodes = [
+        DataflowNode(idx=i, kind=r["kind"], reads=r["reads"],
+                     writes=r["writes"], kills=r["kills"],
+                     edge=r.get("edge"), cross_mesh=r.get("cross", False),
+                     info=r["line"])
+        for i, r in enumerate(recs)
+    ]
+    graph = InstructionDataflowGraph.build(nodes)
+    n_cross = graph.n_cross_mesh
+    n = len(recs)
+
+    ops: List[Any] = []
+    lines: List[str] = []
+    n_groups = 0
+    n_free_hops = 0
+    n_hoisted = 0
+    n_launches = 0
+    run_stats = {"transfer_busy_s": 0.0, "wait_blocked_s": 0.0}
+
+    if mode == "registers":
+        # ---- phase 2a: flat replay with extended same-edge coalescing ----
+        i = 0
+        while i < n:
+            r = recs[i]
+            if r["kind"] != "RESHARD":
+                ops.append(r["op"])
+                lines.append(r["line"])
+                i += 1
+                continue
+            edge = r["edge"]
+            members: List[Dict[str, Any]] = []
+            hopped: List[Dict[str, Any]] = []   # FREEs emitted post-group
+            blocked: set = set()                # slots freed by hopped FREEs
+            counted = 0                         # hopped FREEs with a member
+                                                # appended after them
+            j = i
+            while j < n:
+                q = recs[j]
+                if q["kind"] == "RESHARD" and q["edge"] == edge:
+                    if q["ss"] in blocked or q["ds"] in blocked:
+                        break   # would reorder past a FREE of its slots
+                    if len(hopped) > counted:
+                        n_free_hops += len(hopped) - counted
+                        counted = len(hopped)
+                    members.append(q)
+                    j += 1
+                    continue
+                if q["kind"] == "FREE":
+                    hopped.append(q)
+                    blocked.update(q["slots"])
+                    j += 1
+                    continue
+                break
+            # trailing FREEs (after the last member) keep their original
+            # relative position by being re-emitted after the group
+            if len(members) == 1:
+                m = members[0]
+                ops.append(m["op"])
+                lines.append(m["line"] + " edgegroup=1")
+            else:
+                n_groups += 1
+                ops.append(_make_reshard_group_op(
+                    DirectTransferGroup([m["transfer"] for m in members]),
+                    tuple(m["ss"] for m in members),
+                    tuple(m["ds"] for m in members)))
+                for m in members:
+                    lines.append(m["line"] + f" edgegroup={len(members)}")
+            for q in hopped:
+                ops.append(q["op"])
+                lines.append(q["line"])
+            i = j
+    else:
+        # ---- phase 2b: overlap replay of the dataflow graph ----
+        window = max(1, min(int(overlap_window), max(1, n_cross)))
+        plan, n_hoisted = schedule_overlap(graph, window)
+        # merge consecutive same-edge launches into one batched group
+        group_of: Dict[int, int] = {}
+        group_members: Dict[int, List[int]] = {}
+        k = 0
+        while k < len(plan):
+            kind, idx = plan[k]
+            if kind != "launch":
+                k += 1
+                continue
+            edge = recs[idx]["edge"]
+            mem = [idx]
+            k2 = k + 1
+            while (k2 < len(plan) and plan[k2][0] == "launch" and
+                   recs[plan[k2][1]]["edge"] == edge):
+                mem.append(plan[k2][1])
+                k2 += 1
+            if len(mem) > 1:
+                gid = len(group_members)
+                group_members[gid] = mem
+                for m_ in mem:
+                    group_of[m_] = gid
+            k = k2
+        waited_groups: set = set()
+        for kind, idx in plan:
+            r = recs[idx]
+            if kind == "exec":
+                ops.append(r["op"])
+                lines.append(r["line"])
+            elif kind == "launch":
+                gid = group_of.get(idx)
+                if gid is None:
+                    n_launches += 1
+                    ops.append(_make_launch_op(r["transfer"], r["ss"],
+                                               r["ds"]))
+                    lines.append(f"LAUNCH #{idx} " + r["line"])
+                elif group_members[gid][0] == idx:
+                    n_launches += 1
+                    n_groups += 1
+                    mem = group_members[gid]
+                    ops.append(_make_launch_group_op(
+                        DirectTransferGroup(
+                            [recs[m]["transfer"] for m in mem]),
+                        tuple(recs[m]["ss"] for m in mem),
+                        tuple(recs[m]["ds"] for m in mem)))
+                    lines.append(
+                        f"LAUNCH-GROUP #{mem} edge={r['edge']}")
+                # non-leading group members were folded into the group op
+            else:  # wait
+                gid = group_of.get(idx)
+                if gid is None:
+                    ops.append(_make_wait_op(r["ds"], run_stats))
+                    lines.append(f"WAIT #{idx} slot {r['ds']}")
+                elif gid not in waited_groups:
+                    waited_groups.add(gid)
+                    mem = group_members[gid]
+                    ops.append(_make_wait_group_op(
+                        tuple(recs[m]["ds"] for m in mem), run_stats))
+                    lines.append(f"WAIT-GROUP #{mem}")
+                # later member waits are satisfied by the group wait
+        lines.append(f"MODE overlap window={window} hoisted={n_hoisted} "
+                     f"launches={n_launches}")
 
     return RegisterFileProgram(num_slots=len(slot_of),
                                ops=ops,
@@ -469,7 +943,16 @@ def lower_to_register_file(
                                slot_of=slot_of,
                                n_coalesced_groups=n_groups,
                                n_fixups=n_fixups,
-                               text="\n".join(lines))
+                               text="\n".join(lines),
+                               mode=mode,
+                               graph=graph,
+                               n_cross_mesh=n_cross,
+                               n_hoisted=n_hoisted,
+                               n_launches=n_launches,
+                               n_free_hops=n_free_hops,
+                               overlap_window=(window if mode == "overlap"
+                                               else 0),
+                               run_stats=run_stats)
 
 
 def emit_free_instructions(instructions: List[PipelineInstruction],

@@ -71,6 +71,13 @@ class PipelineSchedule:
                 (f"b{t[0]}s{t[1]}" if t else "-") for t in tick))
         return "\n".join(lines)
 
+    def overlap_window_hint(self) -> int:
+        """Default in-flight transfer window for overlap dispatch (ISSUE
+        4): roughly one eagerly-launched cross-mesh transfer per pipeline
+        rank keeps every mesh's next input moving without unbounded
+        staging memory."""
+        return max(2, min(8, self.num_meshes))
+
 
 class GpipeSchedule(PipelineSchedule):
     """All forwards, then all backwards (ref schedules.py:192)."""
@@ -170,6 +177,11 @@ class OverlapFriendlyPipeDreamSchedule(PipeDreamFlush):
 
     def _warmup_depth(self, mesh_idx: int) -> int:
         return 2 * (self.num_meshes - mesh_idx) - 1
+
+    def overlap_window_hint(self) -> int:
+        # the doubled warmup keeps ~2× more activations in flight, so the
+        # overlap dispatcher gets a proportionally deeper window
+        return max(2, min(16, 2 * self.num_meshes))
 
 
 class InferenceSchedule(PipelineSchedule):

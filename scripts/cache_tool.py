@@ -21,7 +21,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from alpa_tpu.compile_cache import CompileCache  # noqa: E402
+from alpa_tpu.compile_cache import (CompileCache,  # noqa: E402
+                                    CACHE_FORMAT_VERSION, read_entry_format)
 
 
 def _cache_from(args) -> CompileCache:
@@ -63,15 +64,34 @@ def cmd_clear(args):
 
 def cmd_stat(args):
     cache = _cache_from(args)
-    per_ns = collections.defaultdict(lambda: [0, 0])
+    # [count, bytes, current-format, legacy-format, unreadable]
+    per_ns = collections.defaultdict(lambda: [0, 0, 0, 0, 0])
     for e in cache.entries():
-        per_ns[e["namespace"]][0] += 1
-        per_ns[e["namespace"]][1] += e["bytes"]
+        row = per_ns[e["namespace"]]
+        row[0] += 1
+        row[1] += e["bytes"]
+        fmt = read_entry_format(e["path"])
+        if fmt == CACHE_FORMAT_VERSION:
+            row[2] += 1
+        elif fmt is None:
+            row[4] += 1
+        else:
+            row[3] += 1
     print(f"cache dir: {cache.cache_dir}")
+    print(f"current format: v{CACHE_FORMAT_VERSION} "
+          f"(dataflow-graph-aware plans)")
     if not per_ns:
         print("  (empty)")
-    for ns, (n, nbytes) in sorted(per_ns.items()):
-        print(f"  {ns:<14} {n:>5} entries  {nbytes:>10} bytes")
+    for ns, (n, nbytes, cur, legacy, bad) in sorted(per_ns.items()):
+        extra = f"  current={cur} legacy={legacy}"
+        if bad:
+            extra += f" unreadable={bad}"
+        print(f"  {ns:<14} {n:>5} entries  {nbytes:>10} bytes{extra}")
+    legacy_total = sum(v[3] for v in per_ns.values())
+    if legacy_total:
+        print(f"  NOTE: {legacy_total} entries predate the dataflow-graph "
+              f"format; they can never hit (keys embed the format version) "
+              f"— run 'clear' to reclaim the space")
 
 
 def main():

@@ -20,7 +20,9 @@ def test_dispatch_under_1ms_per_instruction_at_8_meshes():
     from scripts.dispatch_overhead_bench import measure
 
     stats = measure(n_steps=5)
-    assert stats["mode"] == "registers"
+    # auto upgrades to overlap on this multi-mesh payload (ISSUE 4);
+    # the sub-ms driver-cost bound applies to either replay mode
+    assert stats["mode"] in ("overlap", "registers")
     assert stats["n_meshes"] == 8
     assert stats["per_inst_us"] < 1000, stats
 

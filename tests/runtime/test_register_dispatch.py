@@ -63,10 +63,20 @@ def test_register_path_matches_interpreter_bitwise():
     np.testing.assert_array_equal(np.asarray(val_s), np.asarray(val_r))
 
 
-def test_auto_mode_picks_registers_when_eligible():
+def test_auto_mode_picks_overlap_when_eligible():
+    """auto on a multi-mesh payload with cross-mesh RESHARDs upgrades to
+    overlap dispatch (ISSUE 4); with overlap_resharding off it pins the
+    synchronous register replay."""
     alpa_tpu.init("local")
     _, _, ex = _run_steps("auto", n_steps=1)
-    assert ex.last_dispatch_stats["mode"] == "registers"
+    assert ex.last_dispatch_stats["mode"] == "overlap"
+    prev = global_config.overlap_resharding
+    global_config.overlap_resharding = False
+    try:
+        _, _, ex = _run_steps("auto", n_steps=1)
+        assert ex.last_dispatch_stats["mode"] == "registers"
+    finally:
+        global_config.overlap_resharding = prev
 
 
 def test_lowering_covers_every_instruction():
