@@ -40,6 +40,7 @@ import numpy as np
 from alpa_tpu.checkpoint import metrics
 from alpa_tpu.checkpoint.policy import RetentionPolicy
 from alpa_tpu.checkpoint.store import (CheckpointNotFoundError, ShardStore)
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -138,6 +139,9 @@ class CheckpointManager:
             plan_fingerprint = executable.get_plan_fingerprint()
 
         t0 = time.monotonic()
+        save_span = _ttrace.begin(
+            "checkpoint.save", "checkpoint",
+            {"step": step} if _ttrace.enabled() else None)
         # double buffer: at most ONE write in flight — step N's write
         # must land (or fail) before step N+1's chunks hit the store,
         # which also keeps retention GC from racing fresh chunk files
@@ -159,6 +163,9 @@ class CheckpointManager:
 
         def write():
             w0 = time.monotonic()
+            wtok = (_ttrace.begin("checkpoint.write", "checkpoint",
+                                  {"step": step}, "ckpt-writer")
+                    if _ttrace.enabled() else None)
             try:
                 self.store.write_step(
                     step, leaves, plan_fingerprint=plan_fingerprint,
@@ -174,6 +181,7 @@ class CheckpointManager:
             finally:
                 self.last_write_seconds = time.monotonic() - w0
                 metrics.incr("write_seconds", self.last_write_seconds)
+                _ttrace.end(wtok)
             metrics.incr("saves")
 
         if sync if sync is not None else not self.async_save:
@@ -188,6 +196,7 @@ class CheckpointManager:
             t.start()
             self.last_blocking_seconds = time.monotonic() - t0
         metrics.incr("blocking_seconds", self.last_blocking_seconds)
+        _ttrace.end(save_span)
 
     def _apply_retention(self):
         if self.policy is None:
@@ -245,6 +254,9 @@ class CheckpointManager:
         import jax
         from flax.serialization import from_state_dict, to_state_dict
         t0 = time.monotonic()
+        restore_span = _ttrace.begin(
+            "checkpoint.restore", "checkpoint",
+            {"step": step} if _ttrace.enabled() else None)
         if expected_plan_fingerprint is None and executable is not None:
             expected_plan_fingerprint = executable.get_plan_fingerprint()
         manifest = self.store.read_manifest(step)
@@ -306,6 +318,7 @@ class CheckpointManager:
         restored = from_state_dict(target, rebuild((), sd))
         metrics.incr("restores")
         metrics.incr("restore_seconds", time.monotonic() - t0)
+        _ttrace.end(restore_span)
         return restored
 
 

@@ -1,27 +1,32 @@
 """Process-global checkpoint counters (exposed via
 ``alpa_tpu.monitoring.get_checkpoint_stats``).
 
-Counters are plain add-only floats/ints behind one lock; timings are
-accumulated seconds.  ``snapshot()`` returns a copy so callers can diff
-before/after an operation without racing the background writer thread.
+Since the unified telemetry layer (ISSUE 5) these live in the central
+metrics registry as the labeled counter family
+``alpa_checkpoint_stat_total{key=...}`` — so ``GET /metrics`` on the
+serving controller exports checkpoint traffic for free.  The original
+module API (``incr``/``snapshot``/``reset``) is preserved as a thin
+view; ``snapshot()`` returns the same ``{name: value}`` dict shape as
+before.
 """
-import threading
 from typing import Dict
 
-_LOCK = threading.Lock()
-_COUNTERS: Dict[str, float] = {}
+from alpa_tpu.telemetry import metrics as _metrics
+
+_FAMILY = _metrics.get_registry().counter(
+    "alpa_checkpoint_stat_total",
+    "Checkpoint traffic counters (saves, restores, staged/written "
+    "bytes, accumulated staging/write/blocking seconds)",
+    labelnames=("key",))
 
 
 def incr(name: str, value: float = 1) -> None:
-    with _LOCK:
-        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+    _FAMILY.labels(name).inc(value)
 
 
 def snapshot() -> Dict[str, float]:
-    with _LOCK:
-        return dict(_COUNTERS)
+    return {key[0]: child.value for key, child in _FAMILY.children()}
 
 
 def reset() -> None:
-    with _LOCK:
-        _COUNTERS.clear()
+    _FAMILY.reset()

@@ -40,6 +40,9 @@ import tempfile
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
+
 logger = logging.getLogger(__name__)
 
 # ``str(jaxpr)`` embeds live function addresses (e.g. custom_jvp's
@@ -180,6 +183,9 @@ class CompileCache:
             if key in self._mem:
                 self._mem.move_to_end(key)
                 self._ns_stats(namespace).hits += 1
+                _ttrace.instant("compile-cache.hit", "compile",
+                                {"namespace": namespace}
+                                if _ttrace.enabled() else None)
                 return self._mem[key]
         path = self._path_of(key)
         if path and os.path.exists(path):
@@ -207,9 +213,15 @@ class CompileCache:
                 st.hits += 1
                 st.disk_hits += 1
                 self._insert_mem(key, value)
+            _ttrace.instant("compile-cache.disk-hit", "compile",
+                            {"namespace": namespace}
+                            if _ttrace.enabled() else None)
             return value
         with self._lock:
             self._ns_stats(namespace).misses += 1
+        _ttrace.instant("compile-cache.miss", "compile",
+                        {"namespace": namespace}
+                        if _ttrace.enabled() else None)
         return None
 
     def put(self, namespace: str, key: str, value: Any):
@@ -327,3 +339,46 @@ def reset_compile_cache(cache: Optional[CompileCache] = None):
 def cache_enabled() -> bool:
     from alpa_tpu.global_env import global_config
     return bool(global_config.compile_cache_enabled)
+
+
+# ---------------------------------------------------------------------
+# metrics registry export (ISSUE 5)
+# ---------------------------------------------------------------------
+# The cache object is swapped per-test (reset_compile_cache), so the
+# registry cannot hold counters directly — a collector pulls the LIVE
+# instance's per-namespace stats into gauges at collect time, keeping
+# GET /metrics truthful without breaking per-test isolation.
+
+_REG = _tmetrics.get_registry()
+_CC_MEMORY = _REG.gauge(
+    "alpa_compile_cache_memory_entries",
+    "Entries resident in the compile cache memory tier")
+_CC_NS_GAUGES = {
+    k: _REG.gauge(f"alpa_compile_cache_{k}", d, labelnames=("namespace",))
+    for k, d in (
+        ("hits", "Compile cache hits (memory + disk)"),
+        ("disk_hits", "Compile cache hits served from the disk tier"),
+        ("misses", "Compile cache misses"),
+        ("puts", "Compile cache stores"),
+        ("solve_seconds", "Seconds spent on solves whose results were "
+                          "cached"),
+        ("saved_seconds", "Solve seconds demonstrably skipped by hits"),
+    )
+}
+
+
+def _collect_compile_cache(_registry):
+    cache = _global_cache
+    if cache is None:
+        _CC_MEMORY.set(0)
+        return
+    st = cache.stats()
+    _CC_MEMORY.set(st["memory_entries"])
+    for fam in _CC_NS_GAUGES.values():
+        fam.reset()   # drop namespaces from a previously-installed cache
+    for ns, d in st["namespaces"].items():
+        for k, fam in _CC_NS_GAUGES.items():
+            fam.labels(ns).set(d[k])
+
+
+_REG.register_collector(_collect_compile_cache)

@@ -48,7 +48,22 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from alpa_tpu.telemetry import metrics as _tmetrics
+
 logger = logging.getLogger(__name__)
+
+_RETRIES_TOTAL = _tmetrics.get_registry().counter(
+    "alpa_fault_retries_total",
+    "Total extra retry attempts per instrumented site",
+    labelnames=("site",))
+_HEALTH_STATE = _tmetrics.get_registry().gauge(
+    "alpa_fault_health_state",
+    "Recovery state machine position "
+    "(0=healthy 1=suspect 2=recovering 3=degraded)")
+_STATE_TRANSITIONS = _tmetrics.get_registry().counter(
+    "alpa_fault_state_transitions_total",
+    "Recovery state machine transitions by destination state",
+    labelnames=("to",))
 
 __all__ = [
     "FaultSpec", "FaultPlan", "InjectedFault", "fire", "active_plan",
@@ -394,6 +409,7 @@ def _account_retries(site: str, extra_attempts: int,
         return
     with _POLICY_LOCK:
         retry_stats[site] = retry_stats.get(site, 0) + extra_attempts
+    _RETRIES_TOTAL.labels(site).inc(extra_attempts)
     plan = active_plan()
     if plan is not None:
         plan._record_retry(site, extra_attempts, delays)
@@ -409,6 +425,15 @@ class MeshHealth(enum.Enum):
     SUSPECT = "suspect"
     RECOVERING = "recovering"
     DEGRADED = "degraded"
+
+
+#: numeric encoding for the alpa_fault_health_state gauge
+_HEALTH_LEVEL = {
+    MeshHealth.HEALTHY: 0,
+    MeshHealth.SUSPECT: 1,
+    MeshHealth.RECOVERING: 2,
+    MeshHealth.DEGRADED: 3,
+}
 
 
 class RecoveryManager:
@@ -484,6 +509,8 @@ class RecoveryManager:
                 return
             self._state = new
             self.transitions.append((old, new, reason))
+        _HEALTH_STATE.set(_HEALTH_LEVEL[new])
+        _STATE_TRANSITIONS.labels(new.value).inc()
         logger.warning("mesh health: %s -> %s (%s)", old.value,
                        new.value, reason)
         self._call(self.on_state_change, old, new)

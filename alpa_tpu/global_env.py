@@ -141,6 +141,22 @@ class GlobalConfig:
         self.compile_cache_memory_entries = int(os.environ.get(
             "ALPA_TPU_COMPILE_CACHE_MEM_ENTRIES", "128"))
 
+        # ---------- telemetry ----------
+        # Span tracing master switch (alpa_tpu/telemetry/trace.py).
+        # Checked as a module-level flag before any allocation: the
+        # register-replay hot path stays within 2% of the no-telemetry
+        # baseline when this is off (guarded in tier-1).
+        self.telemetry_enabled = _env_bool("ALPA_TPU_TRACE", False)
+        # Where scripts/trace_tool.py and instrumented entry points drop
+        # Chrome-trace JSON files.  None = caller chooses.
+        self.telemetry_trace_dir = os.environ.get(
+            "ALPA_TPU_TRACE_DIR", None)
+        # Cap on buffered events per TraceRecorder store (spans /
+        # instants / counters each); overflow increments a drop counter
+        # in the exported trace instead of growing without bound.
+        self.telemetry_max_events = int(os.environ.get(
+            "ALPA_TPU_TRACE_MAX_EVENTS", "200000"))
+
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS
         # (ref: DaemonMoveWorker).

@@ -25,8 +25,19 @@ import jax
 import jax.numpy as jnp
 
 from alpa_tpu import fault
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
+
+_WATCHDOG_LAST_OK = _tmetrics.get_registry().gauge(
+    "alpa_watchdog_last_ok_timestamp",
+    "Unix time of each mesh's last successful liveness probe",
+    labelnames=("mesh",))
+_WATCHDOG_FAILS = _tmetrics.get_registry().gauge(
+    "alpa_watchdog_consecutive_failures",
+    "Consecutive failed liveness probes per mesh",
+    labelnames=("mesh",))
 
 
 def check_alive(mesh, timeout: float = 10.0,
@@ -124,6 +135,13 @@ class FailureWatchdog:
             if self._stop.is_set():
                 return  # stopped during the probe: don't fire callbacks
             dead = [i for i, a in enumerate(alive) if not a]
+            now = time.time()
+            for i, ok in enumerate(alive):
+                if ok:
+                    _WATCHDOG_LAST_OK.labels(str(i)).set(now)
+                    _WATCHDOG_FAILS.labels(str(i)).set(0)
+                else:
+                    _WATCHDOG_FAILS.labels(str(i)).inc()
             if dead:
                 try:
                     self.on_failure(dead)
@@ -162,6 +180,11 @@ def dump_debug_info(executable, dump_dir: str):
     write("compile_cache.txt", format_compile_cache_report())
     write("checkpoint.txt", format_checkpoint_report())
     write("overlap.txt", format_overlap_report())
+    write("metrics.txt", _tmetrics.get_registry().to_prometheus_text())
+    if _ttrace.enabled():
+        rec = _ttrace.get_recorder()
+        if rec.n_events:
+            rec.save(os.path.join(dump_dir, "trace.json"))
     logger.info("debug info dumped to %s", dump_dir)
 
 

@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from alpa_tpu import fault
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -362,38 +364,53 @@ def plan_send_order(spec: ReshardingTaskSpec
     return tuple(order)
 
 
-# process-global planner counters, surfaced by monitoring (ISSUE 4)
-_planner_counters = {
-    "plans": 0,
-    "total_bytes": 0.0,
-    "broadcast_bytes": 0.0,
-    "max_link_bytes": 0.0,          # max over plans, balanced routing
-    "max_link_bytes_naive": 0.0,    # max over plans, naive routing
-}
+# process-global planner counters, kept in the central metrics registry
+# (ISSUE 5: exported on GET /metrics as alpa_resharding_*) and surfaced
+# by monitoring.get_overlap_stats with the pre-telemetry dict shape.
+_PLANNER_REG = _tmetrics.get_registry()
+_PLANS = _PLANNER_REG.counter(
+    "alpa_resharding_plans_total", "Resharding plans computed")
+_PLAN_BYTES = _PLANNER_REG.counter(
+    "alpa_resharding_planned_bytes_total",
+    "Planned cross-mesh payload bytes (send_recv accounting)")
+_PLAN_BCAST_BYTES = _PLANNER_REG.counter(
+    "alpa_resharding_planned_broadcast_bytes_total",
+    "Planned cross-mesh payload bytes under broadcast routing")
+_PLAN_MAX_LINK = _PLANNER_REG.gauge(
+    "alpa_resharding_max_link_bytes",
+    "Max per-device link bytes over all plans, balanced routing")
+_PLAN_MAX_LINK_NAIVE = _PLANNER_REG.gauge(
+    "alpa_resharding_max_link_bytes_naive",
+    "Max per-device link bytes over all plans, naive routing")
 
 
 def _record_plan(spec: ReshardingTaskSpec):
-    c = _planner_counters
-    c["plans"] += 1
-    c["total_bytes"] += spec.transfer_bytes
-    c["broadcast_bytes"] += spec.broadcast_bytes
-    c["max_link_bytes"] = max(
-        c["max_link_bytes"], spec.max_link_bytes,
-        spec.max_link_bytes_broadcast)
-    c["max_link_bytes_naive"] = max(
-        c["max_link_bytes_naive"], spec.max_link_bytes_naive,
-        spec.max_link_bytes_broadcast_naive)
+    _PLANS.inc()
+    _PLAN_BYTES.inc(spec.transfer_bytes)
+    _PLAN_BCAST_BYTES.inc(spec.broadcast_bytes)
+    _PLAN_MAX_LINK.set_max(max(spec.max_link_bytes,
+                               spec.max_link_bytes_broadcast))
+    _PLAN_MAX_LINK_NAIVE.set_max(max(spec.max_link_bytes_naive,
+                                     spec.max_link_bytes_broadcast_naive))
 
 
 def get_planner_stats() -> Dict[str, float]:
     """Snapshot of the resharding planner counters (plans made, planned
-    total/broadcast bytes, max-link objective balanced vs naive)."""
-    return dict(_planner_counters)
+    total/broadcast bytes, max-link objective balanced vs naive) — a
+    thin view over the metrics registry, same dict shape as before."""
+    return {
+        "plans": int(_PLANS.value),
+        "total_bytes": _PLAN_BYTES.value,
+        "broadcast_bytes": _PLAN_BCAST_BYTES.value,
+        "max_link_bytes": _PLAN_MAX_LINK.value,
+        "max_link_bytes_naive": _PLAN_MAX_LINK_NAIVE.value,
+    }
 
 
 def reset_planner_stats():
-    for k in _planner_counters:
-        _planner_counters[k] = 0 if k == "plans" else 0.0
+    for fam in (_PLANS, _PLAN_BYTES, _PLAN_BCAST_BYTES, _PLAN_MAX_LINK,
+                _PLAN_MAX_LINK_NAIVE):
+        fam.reset()
 
 
 def plan_resharding(shape: Tuple[int, ...],
@@ -417,6 +434,7 @@ def plan_resharding(shape: Tuple[int, ...],
         loadbalance = (getattr(global_config,
                                "resharding_loadbalance_mode",
                                "normal") != "no_loadbalance")
+    tok = _ttrace.begin("plan_resharding", "resharding")
     src_vda = VirtualDistributedArray.from_sharding(shape, src_sharding)
     dst_vda = VirtualDistributedArray.from_sharding(shape, dst_sharding)
 
@@ -465,6 +483,7 @@ def plan_resharding(shape: Tuple[int, ...],
         spec.max_link_bytes_naive = spec.max_link_bytes
         spec.max_link_bytes_broadcast_naive = spec.max_link_bytes_broadcast
     _record_plan(spec)
+    _ttrace.end(tok)
     return spec
 
 
@@ -553,13 +572,18 @@ class DirectTransfer:
     """
 
     __slots__ = ("dst_sharding", "src_sharding", "ndim", "fast",
-                 "_dst_devices", "_semantics")
+                 "nbytes", "_dst_devices", "_semantics")
 
     def __init__(self, aval, src_sharding, dst_sharding):
         self.dst_sharding = dst_sharding
         self.src_sharding = src_sharding
         self.ndim = len(getattr(aval, "shape", ()))
         shape = tuple(getattr(aval, "shape", ()))
+        try:
+            self.nbytes = int(np.prod(shape, dtype=np.int64) *
+                              np.dtype(aval.dtype).itemsize)
+        except Exception:  # pylint: disable=broad-except
+            self.nbytes = 0
         self.fast = (src_sharding is not None and shard_structures_match(
             shape, src_sharding, dst_sharding))
         self._dst_devices = None
@@ -574,6 +598,16 @@ class DirectTransfer:
                 self.fast = False
 
     def __call__(self, val):
+        if _ttrace.enabled():
+            # per-edge bytes + latency (the span's duration) on the
+            # calling thread's track (driver or pool worker)
+            with _ttrace.get_recorder().span(
+                    "reshard.edge", "resharding",
+                    {"bytes": self.nbytes, "fast": self.fast}):
+                return self._transfer(val)
+        return self._transfer(val)
+
+    def _transfer(self, val):
         out = None
         if self.fast:
             try:
@@ -609,6 +643,16 @@ class DirectTransferGroup:
         return len(self.transfers)
 
     def __call__(self, vals):
+        if _ttrace.enabled():
+            with _ttrace.get_recorder().span(
+                    "reshard.edge-group", "resharding",
+                    {"bytes": sum(t.nbytes for t in self.transfers),
+                     "n": len(self.transfers),
+                     "fast": self.all_fast}):
+                return self._transfer(vals)
+        return self._transfer(vals)
+
+    def _transfer(self, vals):
         ts = self.transfers
         out = None
         if self.all_fast:
@@ -687,6 +731,15 @@ class ReshardingTask:
         self.last_report: Optional[ExecutionReport] = None
 
     def run(self, src_array, mode: Optional[str] = None):
+        if _ttrace.enabled():
+            with _ttrace.get_recorder().span(
+                    "reshard.task", "resharding",
+                    {"mode": mode or self.mode,
+                     "bytes": self.spec.transfer_bytes}):
+                return self._run(src_array, mode)
+        return self._run(src_array, mode)
+
+    def _run(self, src_array, mode: Optional[str] = None):
         import jax
         mode = mode or self.mode
         fault.fire("cross_mesh_recv", mode=mode,

@@ -30,6 +30,7 @@ from alpa_tpu.pipeline_parallel.runtime_emitter import (
     PlacementSpecEntry, emit_free_instructions, partition_streams)
 from alpa_tpu.pipeline_parallel.schedules import create_pipeline_schedule
 from alpa_tpu.shard_parallel.auto_sharding import MESH_AXIS_NAMES
+from alpa_tpu.telemetry import trace as _ttrace
 from alpa_tpu.timer import timers, tracer
 from alpa_tpu.util import OrderedSet
 
@@ -132,8 +133,11 @@ class StageExecutable:
                          in_shardings=tuple(in_shardings),
                          out_shardings=out_shardings,
                          donate_argnums=self.donate_idx)
-        lowered = jitted.lower(*self._avals)
-        self.compiled = lowered.compile()
+        with _ttrace.span("xla-compile", "compile",
+                          {"stage": self.name} if _ttrace.enabled()
+                          else None):
+            lowered = jitted.lower(*self._avals)
+            self.compiled = lowered.compile()
         self.out_shardings = list(self.compiled.output_shardings)
 
     def sharding_for(self, var) -> Any:
@@ -592,9 +596,11 @@ class PipeshardDriverExecutable:
             self._inflight_launches += 1
         timer = timers("pipeshard-dispatch")
         timer.start()
+        step_span = _ttrace.begin("pipeshard.step", "runtime")
         try:
             return self._launch(*flat_args)
         finally:
+            _ttrace.end(step_span)
             timer.stop()
             with self._quiesce_cv:
                 self._inflight_launches -= 1
@@ -1035,6 +1041,21 @@ class PipeshardDriverExecutable:
     def _exec_inst(self, inst, ctx):
         """Execute one pipeline instruction (shared by the sequential loop
         and the per-stream worker threads)."""
+        if _ttrace.enabled():
+            # per-instruction span on the destination mesh's track (the
+            # interpreter analog of the register replay's op_meta spans)
+            opname = inst.opcode.name
+            mesh = (inst.free_keys[0][2]
+                    if opname == "FREE" and inst.free_keys
+                    else inst.dst_mesh)
+            with _ttrace.get_recorder().span(
+                    (f"{opname} {inst.info}" if inst.info else opname),
+                    "instruction", None, f"mesh {mesh}"):
+                self._exec_inst_inner(inst, ctx)
+            return
+        self._exec_inst_inner(inst, ctx)
+
+    def _exec_inst_inner(self, inst, ctx):
         env, _put, exec_mode, mp_planned, collect, _stats = ctx
         if inst.opcode == PipelineInstType.RUN:
             exec_ = inst.executable

@@ -33,6 +33,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from jax.extend.core import Var
 
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
+
 logger = logging.getLogger(__name__)
 
 
@@ -482,13 +485,31 @@ class RegisterFileProgram:
     run_stats: Dict[str, float] = dataclasses.field(
         default_factory=lambda: {"transfer_busy_s": 0.0,
                                  "wait_blocked_s": 0.0})
+    # telemetry (ISSUE 5): per-op (span name, category, track) built at
+    # lowering time; only consulted when tracing is on — the hot replay
+    # checks the enabled flag ONCE per step, not per op.
+    op_meta: Optional[List[Tuple[str, str, str]]] = None
 
     def execute(self, regs: List[Any]):
         rs = self.run_stats
         rs["transfer_busy_s"] = 0.0
         rs["wait_blocked_s"] = 0.0
+        if _ttrace.enabled():
+            self._execute_traced(regs)
+            return
         for op in self.ops:
             op(regs)
+
+    def _execute_traced(self, regs: List[Any]):
+        meta = self.op_meta
+        if meta is None or len(meta) != len(self.ops):
+            for op in self.ops:
+                op(regs)
+            return
+        rec = _ttrace.get_recorder()
+        for op, (name, cat, track) in zip(self.ops, meta):
+            with rec.span(name, cat, None, track):
+                op(regs)
 
     def fingerprint(self) -> str:
         import hashlib
@@ -579,18 +600,39 @@ class _PendingTransfer:
         self.future = future
 
 
-def _make_launch_op(transfer, src_slot, dst_slot):
+# launched-but-unretired transfers, exported to the trace as the
+# "transfers_in_flight" counter track (only touched when tracing is on)
+_INFLIGHT_LOCK = threading.Lock()
+_INFLIGHT = 0
+
+
+def _inflight_delta(d: int):
+    global _INFLIGHT
+    with _INFLIGHT_LOCK:
+        _INFLIGHT += d
+        v = _INFLIGHT
+    _ttrace.counter("transfers_in_flight", v)
+
+
+def _make_launch_op(transfer, src_slot, dst_slot, label="transfer"):
     # regs[src] is captured on the driver thread at launch time, so a
     # later donation/FREE of the src slot (which the schedule orders
     # after this launch's wait anyway) can never race the worker.
-    def op(regs, _t=transfer, _s=src_slot, _d=dst_slot):
+    def op(regs, _t=transfer, _s=src_slot, _d=dst_slot, _l=label):
         v = regs[_s]
 
-        def work(_v=v, _tt=_t):
+        def work(_v=v, _tt=_t, _ll=_l):
+            # pool-side launch→retire span on the worker thread's track
+            tok = _ttrace.begin(_ll, "transfer") if _ttrace.enabled() \
+                else None
             t0 = time.perf_counter()
             out = _tt(_v)
-            return out, time.perf_counter() - t0
+            busy = time.perf_counter() - t0
+            _ttrace.end(tok)
+            return out, busy
 
+        if _ttrace.enabled():
+            _inflight_delta(1)
         regs[_d] = _PendingTransfer(_transfer_pool().submit(work))
 
     return op
@@ -605,21 +647,30 @@ def _make_wait_op(dst_slot, stats):
             _st["wait_blocked_s"] += time.perf_counter() - t0
             _st["transfer_busy_s"] += busy
             regs[_d] = out
+            if _ttrace.enabled():
+                _inflight_delta(-1)
 
     return op
 
 
-def _make_launch_group_op(group, src_slots, dst_slots):
+def _make_launch_group_op(group, src_slots, dst_slots,
+                          label="transfer-group"):
     # The whole batched group travels as one future, parked at the first
     # member's dst slot; the group wait scatters every output.
-    def op(regs, _g=group, _s=src_slots, _d=dst_slots):
+    def op(regs, _g=group, _s=src_slots, _d=dst_slots, _l=label):
         vals = [regs[s] for s in _s]
 
-        def work(_v=vals, _gg=_g):
+        def work(_v=vals, _gg=_g, _ll=_l):
+            tok = _ttrace.begin(_ll, "transfer") if _ttrace.enabled() \
+                else None
             t0 = time.perf_counter()
             outs = _gg(_v)
-            return outs, time.perf_counter() - t0
+            busy = time.perf_counter() - t0
+            _ttrace.end(tok)
+            return outs, busy
 
+        if _ttrace.enabled():
+            _inflight_delta(1)
         regs[_d[0]] = _PendingTransfer(_transfer_pool().submit(work))
 
     return op
@@ -635,43 +686,69 @@ def _make_wait_group_op(dst_slots, stats):
             _st["transfer_busy_s"] += busy
             for d, o in zip(_d, outs):
                 regs[d] = o
+            if _ttrace.enabled():
+                _inflight_delta(-1)
 
     return op
 
 
-# process-wide overlap runtime counters (surfaced via monitoring)
-_overlap_totals = {
-    "steps": 0,
-    "transfer_busy_s": 0.0,
-    "wait_blocked_s": 0.0,
-    "n_hoisted": 0,
-    "n_launches": 0,
-    "last_overlap_fraction": 0.0,
-    "last_window": 0,
-}
+# process-wide overlap runtime counters, kept in the central metrics
+# registry (ISSUE 5) and surfaced via monitoring.get_overlap_stats —
+# the same series GET /metrics exports as alpa_overlap_*.
+_OVERLAP_REG = _tmetrics.get_registry()
+_OVERLAP_STEPS = _OVERLAP_REG.counter(
+    "alpa_overlap_steps_total", "Overlap-mode pipeshard steps executed")
+_OVERLAP_BUSY = _OVERLAP_REG.counter(
+    "alpa_overlap_transfer_busy_seconds_total",
+    "Accumulated pool-side transfer execution time")
+_OVERLAP_BLOCKED = _OVERLAP_REG.counter(
+    "alpa_overlap_wait_blocked_seconds_total",
+    "Accumulated driver time blocked in transfer waits")
+_OVERLAP_HOISTED = _OVERLAP_REG.counter(
+    "alpa_overlap_hoisted_total",
+    "Cross-mesh transfers launched ahead of flat instruction order")
+_OVERLAP_LAUNCHES = _OVERLAP_REG.counter(
+    "alpa_overlap_launches_total",
+    "Async transfer launches issued (a batched group counts once)")
+_OVERLAP_LAST_FRACTION = _OVERLAP_REG.gauge(
+    "alpa_overlap_last_overlap_fraction",
+    "Last step's 1 - wait_blocked/transfer_busy overlap fraction")
+_OVERLAP_LAST_WINDOW = _OVERLAP_REG.gauge(
+    "alpa_overlap_last_window",
+    "Last step's in-flight transfer window")
 
 
 def record_overlap_step(stats: Dict[str, Any]) -> None:
-    """Fold one overlap-mode step's dispatch stats into the process-wide
-    counters (called by pipeshard_executable after each launch)."""
-    _overlap_totals["steps"] += 1
-    _overlap_totals["transfer_busy_s"] += stats.get("transfer_busy_s", 0.0)
-    _overlap_totals["wait_blocked_s"] += stats.get("wait_blocked_s", 0.0)
-    _overlap_totals["n_hoisted"] += stats.get("n_hoisted", 0)
-    _overlap_totals["n_launches"] += stats.get("n_launches", 0)
-    _overlap_totals["last_overlap_fraction"] = stats.get(
-        "overlap_fraction", 0.0)
-    _overlap_totals["last_window"] = stats.get("overlap_window", 0)
+    """Fold one overlap-mode step's dispatch stats into the registry
+    (called by pipeshard_executable after each launch)."""
+    _OVERLAP_STEPS.inc()
+    _OVERLAP_BUSY.inc(stats.get("transfer_busy_s", 0.0))
+    _OVERLAP_BLOCKED.inc(stats.get("wait_blocked_s", 0.0))
+    _OVERLAP_HOISTED.inc(stats.get("n_hoisted", 0))
+    _OVERLAP_LAUNCHES.inc(stats.get("n_launches", 0))
+    _OVERLAP_LAST_FRACTION.set(stats.get("overlap_fraction", 0.0))
+    _OVERLAP_LAST_WINDOW.set(stats.get("overlap_window", 0))
 
 
 def get_overlap_runtime_stats() -> Dict[str, Any]:
-    return dict(_overlap_totals)
+    """Thin view over the registry; dict shape is unchanged from the
+    pre-telemetry module-private counters."""
+    return {
+        "steps": int(_OVERLAP_STEPS.value),
+        "transfer_busy_s": _OVERLAP_BUSY.value,
+        "wait_blocked_s": _OVERLAP_BLOCKED.value,
+        "n_hoisted": int(_OVERLAP_HOISTED.value),
+        "n_launches": int(_OVERLAP_LAUNCHES.value),
+        "last_overlap_fraction": _OVERLAP_LAST_FRACTION.value,
+        "last_window": int(_OVERLAP_LAST_WINDOW.value),
+    }
 
 
 def reset_overlap_runtime_stats() -> None:
-    _overlap_totals.update(steps=0, transfer_busy_s=0.0, wait_blocked_s=0.0,
-                           n_hoisted=0, n_launches=0,
-                           last_overlap_fraction=0.0, last_window=0)
+    for fam in (_OVERLAP_STEPS, _OVERLAP_BUSY, _OVERLAP_BLOCKED,
+                _OVERLAP_HOISTED, _OVERLAP_LAUNCHES,
+                _OVERLAP_LAST_FRACTION, _OVERLAP_LAST_WINDOW):
+        fam.reset()
 
 
 def lower_to_register_file(
@@ -760,6 +837,8 @@ def lower_to_register_file(
                 "reads": tuple(in_slots),
                 "writes": tuple(out_slots),
                 "kills": kills,
+                "name": f"RUN {inst.info}",
+                "mesh": inst.dst_mesh,
                 "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "
                          f"fix={[(p, str(s)) for p, s, _ in fixups]}"),
@@ -783,6 +862,8 @@ def lower_to_register_file(
                 "reads": (ss,),
                 "writes": (ds,),
                 "kills": (),
+                "name": f"RESHARD {inst.src_mesh}->{inst.dst_mesh}",
+                "mesh": inst.dst_mesh,
                 "line": (f"RESHARD {inst.var_key} {inst.src_mesh}->"
                          f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}"),
             })
@@ -796,6 +877,8 @@ def lower_to_register_file(
                 "reads": (),
                 "writes": (),
                 "kills": slots,
+                "name": "FREE",
+                "mesh": inst.free_keys[0][2] if inst.free_keys else 0,
                 "line": f"FREE {list(slots)}",
             })
 
@@ -812,6 +895,7 @@ def lower_to_register_file(
 
     ops: List[Any] = []
     lines: List[str] = []
+    meta: List[Tuple[str, str, str]] = []   # (span name, category, track)
     n_groups = 0
     n_free_hops = 0
     n_hoisted = 0
@@ -826,6 +910,8 @@ def lower_to_register_file(
             if r["kind"] != "RESHARD":
                 ops.append(r["op"])
                 lines.append(r["line"])
+                meta.append((r["name"], "instruction",
+                             f"mesh {r['mesh']}"))
                 i += 1
                 continue
             edge = r["edge"]
@@ -858,6 +944,8 @@ def lower_to_register_file(
                 m = members[0]
                 ops.append(m["op"])
                 lines.append(m["line"] + " edgegroup=1")
+                meta.append((m["name"], "instruction",
+                             f"mesh {m['mesh']}"))
             else:
                 n_groups += 1
                 ops.append(_make_reshard_group_op(
@@ -866,9 +954,15 @@ def lower_to_register_file(
                     tuple(m["ds"] for m in members)))
                 for m in members:
                     lines.append(m["line"] + f" edgegroup={len(members)}")
+                meta.append((
+                    f"RESHARD-GROUP x{len(members)} "
+                    f"{edge[0]}->{edge[1]}", "instruction",
+                    f"mesh {members[0]['mesh']}"))
             for q in hopped:
                 ops.append(q["op"])
                 lines.append(q["line"])
+                meta.append((q["name"], "instruction",
+                             f"mesh {q['mesh']}"))
             i = j
     else:
         # ---- phase 2b: overlap replay of the dataflow graph ----
@@ -902,13 +996,18 @@ def lower_to_register_file(
             if kind == "exec":
                 ops.append(r["op"])
                 lines.append(r["line"])
+                meta.append((r["name"], "instruction",
+                             f"mesh {r['mesh']}"))
             elif kind == "launch":
                 gid = group_of.get(idx)
                 if gid is None:
                     n_launches += 1
-                    ops.append(_make_launch_op(r["transfer"], r["ss"],
-                                               r["ds"]))
+                    ops.append(_make_launch_op(
+                        r["transfer"], r["ss"], r["ds"],
+                        label=r["name"]))
                     lines.append(f"LAUNCH #{idx} " + r["line"])
+                    meta.append((f"LAUNCH {r['name']}", "transfer",
+                                 f"mesh {r['mesh']}"))
                 elif group_members[gid][0] == idx:
                     n_launches += 1
                     n_groups += 1
@@ -917,21 +1016,30 @@ def lower_to_register_file(
                         DirectTransferGroup(
                             [recs[m]["transfer"] for m in mem]),
                         tuple(recs[m]["ss"] for m in mem),
-                        tuple(recs[m]["ds"] for m in mem)))
+                        tuple(recs[m]["ds"] for m in mem),
+                        label=(f"{r['name']} x{len(mem)}")))
                     lines.append(
                         f"LAUNCH-GROUP #{mem} edge={r['edge']}")
+                    meta.append((
+                        f"LAUNCH-GROUP x{len(mem)} "
+                        f"{r['edge'][0]}->{r['edge'][1]}", "transfer",
+                        f"mesh {r['mesh']}"))
                 # non-leading group members were folded into the group op
             else:  # wait
                 gid = group_of.get(idx)
                 if gid is None:
                     ops.append(_make_wait_op(r["ds"], run_stats))
                     lines.append(f"WAIT #{idx} slot {r['ds']}")
+                    meta.append((f"WAIT {r['name']}", "transfer",
+                                 f"mesh {r['mesh']}"))
                 elif gid not in waited_groups:
                     waited_groups.add(gid)
                     mem = group_members[gid]
                     ops.append(_make_wait_group_op(
                         tuple(recs[m]["ds"] for m in mem), run_stats))
                     lines.append(f"WAIT-GROUP #{mem}")
+                    meta.append((f"WAIT-GROUP x{len(mem)}", "transfer",
+                                 f"mesh {r['mesh']}"))
                 # later member waits are satisfied by the group wait
         lines.append(f"MODE overlap window={window} hoisted={n_hoisted} "
                      f"launches={n_launches}")
@@ -952,7 +1060,8 @@ def lower_to_register_file(
                                n_free_hops=n_free_hops,
                                overlap_window=(window if mode == "overlap"
                                                else 0),
-                               run_stats=run_stats)
+                               run_stats=run_stats,
+                               op_meta=meta)
 
 
 def emit_free_instructions(instructions: List[PipelineInstruction],

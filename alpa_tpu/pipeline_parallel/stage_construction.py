@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from alpa_tpu.device_mesh import VirtualPhysicalMesh
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -220,6 +221,7 @@ def cluster_layers_and_slice_mesh(
                         virtual_mesh, entry["phys_shapes"])
                     cache.record_saved_seconds(
                         "stage_dp", entry.get("solve_seconds", 0.0))
+                    _ttrace.instant("stage-dp-cache-hit", "compile")
                     return (entry["fwd_ids"], submeshes,
                             entry["logical_shapes"], entry["as_dicts"])
                 except Exception:  # pylint: disable=broad-except
@@ -228,10 +230,14 @@ def cluster_layers_and_slice_mesh(
 
         import time
         tic = time.time()
-        fwd_ids, submeshes, logical_shapes, as_dicts = auto_stage_dp(
-            num_forward_layers, virtual_mesh, stage_option,
-            layer_flops, layer_comps, num_micro_batches,
-            auto_sharding_option, objective=objective, schedule=schedule)
+        with _ttrace.span("stage-dp", "compile",
+                          {"layers": num_forward_layers}
+                          if _ttrace.enabled() else None):
+            fwd_ids, submeshes, logical_shapes, as_dicts = auto_stage_dp(
+                num_forward_layers, virtual_mesh, stage_option,
+                layer_flops, layer_comps, num_micro_batches,
+                auto_sharding_option, objective=objective,
+                schedule=schedule)
         if cache is not None and key is not None:
             solve_seconds = time.time() - tic
             cache.record_solve_seconds("stage_dp", solve_seconds)

@@ -15,11 +15,17 @@ Endpoints:
         => {"output_ids": [[...]]}
   GET  /health                          -> {"status": "ok" | "degraded"
         | "shedding"} (503 when shedding; see docs/fault_tolerance.md)
+  GET  /healthz                         -> recovery-state liveness probe
+        (200 healthy/suspect/recovering, 503 degraded;
+        docs/observability.md)
+  GET  /metrics                         -> Prometheus text exposition of
+        the process metrics registry (docs/observability.md)
 """
 import dataclasses
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
@@ -27,8 +33,25 @@ import numpy as np
 
 from alpa_tpu import fault
 from alpa_tpu.serve.generation import GenerationConfig, Generator
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
+
+_REG = _tmetrics.get_registry()
+_QUEUE_DEPTH = _REG.gauge(
+    "alpa_serving_queue_depth", "Requests waiting in the batcher queue")
+_BATCH_SIZE = _REG.histogram(
+    "alpa_serving_batch_size", "Prompts per batched generate call",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+_BATCHES = _REG.counter(
+    "alpa_serving_batches_total", "Batched generate calls executed")
+_REQUESTS = _REG.counter(
+    "alpa_serving_requests_total", "Completion requests by outcome",
+    labelnames=("outcome",))
+_REQ_LATENCY = _REG.histogram(
+    "alpa_serving_request_seconds",
+    "End-to-end /completions latency (batched path)")
 
 
 class RequestBatcher:
@@ -169,6 +192,7 @@ class RequestBatcher:
                     continue
                 if not batch:
                     continue
+                _QUEUE_DEPTH.set(len(self._queue))
             try:
                 with self._gen_lock:
                     prompts = [p for it in batch for p in it["prompts"]]
@@ -176,9 +200,17 @@ class RequestBatcher:
                         batch[0]["cfg"],
                         max_new_tokens=max(it["cfg"].max_new_tokens
                                            for it in batch))
-                    outs = self.generator.generate(prompts, run_cfg,
-                                                   prefix=self.prefix)
+                    _BATCH_SIZE.observe(len(prompts))
+                    with _ttrace.span(
+                            "batcher.generate", "serving",
+                            {"prompts": len(prompts),
+                             "max_new_tokens": run_cfg.max_new_tokens}
+                            if _ttrace.enabled() else None,
+                            "serve-batcher"):
+                        outs = self.generator.generate(prompts, run_cfg,
+                                                       prefix=self.prefix)
                 self.batches_run += 1
+                _BATCHES.inc()
                 i = 0
                 for it in batch:
                     k = len(it["prompts"])
@@ -288,6 +320,8 @@ class Controller:
         # with ServiceDegradedError (HTTP 503) until recovery clears it
         self._health = "ok"
         self._health_reason: Optional[str] = None
+        #: bound RecoveryManager (attach_recovery) — drives /healthz
+        self._recovery = None
         #: completed hot swaps, newest last (introspection + /admin)
         self.reloads: List[Dict[str, Any]] = []
 
@@ -319,6 +353,7 @@ class Controller:
     def attach_recovery(self, recovery) -> None:
         """Bind a :class:`alpa_tpu.fault.RecoveryManager`: entering
         DEGRADED sheds load here (503s), recovering restores service."""
+        self._recovery = recovery
         recovery.on_degrade = (
             lambda reason=None: self.set_health(
                 "shedding", reason or "mesh recovery failed"))
@@ -458,10 +493,26 @@ class Controller:
         return self._pick_replica(name), prompt_ids, cfg, queue
 
     def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
-        replica, prompt_ids, cfg, queue = self._parse_request(request)
-        if prompt_ids.ndim == 1:
-            prompt_ids = prompt_ids[None]
-        outs = replica.batcher.submit(list(prompt_ids), cfg, queue=queue)
+        tic = time.monotonic()
+        try:
+            with _ttrace.span("serve.request", "serving",
+                              {"model": str(request.get("model"))}
+                              if _ttrace.enabled() else None,
+                              "serve-driver"):
+                replica, prompt_ids, cfg, queue = \
+                    self._parse_request(request)
+                if prompt_ids.ndim == 1:
+                    prompt_ids = prompt_ids[None]
+                outs = replica.batcher.submit(list(prompt_ids), cfg,
+                                              queue=queue)
+        except fault.ServiceDegradedError:
+            _REQUESTS.labels("shed").inc()
+            raise
+        except Exception:
+            _REQUESTS.labels("error").inc()
+            raise
+        _REQUESTS.labels("ok").inc()
+        _REQ_LATENCY.observe(time.monotonic() - tic)
         return {"output_ids": [o.tolist() for o in outs]}
 
     def completions_stream(self, request: Dict[str, Any]):
@@ -492,11 +543,47 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str,
+                   content_type: str = "text/plain; version=0.0.4"):
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _metrics(self):
+        """Prometheus text exposition of the whole process registry.
+        Importing monitoring first guarantees every module-level family
+        (watchdog gauges, compile-cache collector, ...) is registered
+        even when the controller is the only thing this process ran."""
+        import alpa_tpu.monitoring  # noqa: F401  pylint: disable=unused-import
+        self._send_text(200, _tmetrics.get_registry().to_prometheus_text())
+
+    def _healthz(self):
+        """Liveness wired to the recovery state machine: 200 while
+        HEALTHY/SUSPECT/RECOVERING (body carries the state), 503 once
+        DEGRADED.  Falls back to the controller health report when no
+        RecoveryManager is attached."""
+        recovery = self.controller._recovery
+        if recovery is not None:
+            state = recovery.state.value
+            code = 503 if state == "degraded" else 200
+            self._send(code, {"status": state})
+            return
+        report = self.controller.health_report()
+        code = 503 if report["status"] == "shedding" else 200
+        self._send(code, report)
+
     def do_GET(self):
         if self.path == "/health":
             report = self.controller.health_report()
             code = 503 if report["status"] == "shedding" else 200
             self._send(code, report)
+        elif self.path == "/healthz":
+            self._healthz()
+        elif self.path == "/metrics":
+            self._metrics()
         elif self.path == "/models":
             self._send(200, {"models": self.controller.list_models()})
         else:

@@ -13,6 +13,7 @@ make this possible: every row decodes at its own position.
 import dataclasses
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -23,8 +24,25 @@ from alpa_tpu import fault
 from alpa_tpu.model.gpt_model import init_kv_caches
 from alpa_tpu.serve.generation import (GenerationConfig, Generator,
                                        _sample_logits)
+from alpa_tpu.telemetry import metrics as _tmetrics
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
+
+_REG = _tmetrics.get_registry()
+_ADMISSIONS = _REG.counter(
+    "alpa_serving_admissions_total", "Requests admitted to a KV-cache row")
+_DECODE_STEPS = _REG.counter(
+    "alpa_serving_decode_steps_total", "Engine decode ticks executed")
+_TOKENS = _REG.counter(
+    "alpa_serving_tokens_total", "Tokens generated across all requests")
+_STEP_FAILURES = _REG.counter(
+    "alpa_serving_step_failures_total", "Engine decode ticks that raised")
+_ACTIVE_ROWS = _REG.gauge(
+    "alpa_serving_active_rows", "KV-cache rows currently decoding")
+_TTFT = _REG.histogram(
+    "alpa_serving_ttft_seconds",
+    "Time from submit to first generated token")
 
 _STREAM_END = object()
 
@@ -256,7 +274,8 @@ class ContinuousBatchingEngine:
         return {"prompt": prompt, "cfg": cfg, "tokens": [],
                 "done": _DoneEvent(on_done), "error": None,
                 "on_token": on_token, "cancelled": False,
-                "queue": queue or "default"}
+                "queue": queue or "default",
+                "t_submit": time.monotonic()}
 
     def shutdown(self):
         with self._cv:
@@ -308,6 +327,7 @@ class ContinuousBatchingEngine:
                         self._rows[r] = item
                         self._active[r] = True
                         self.admissions += 1
+                        _ADMISSIONS.inc()
                     self._caches, self._logits = self._scatter_packed(
                         self._caches, row_caches, self._logits,
                         last.astype(jnp.float32), jnp.asarray(rowmap),
@@ -354,6 +374,7 @@ class ContinuousBatchingEngine:
                 self._rows[r] = item
                 self._active[r] = True
                 self.admissions += 1
+                _ADMISSIONS.inc()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception("row admission failed")
                 item["error"] = e
@@ -380,10 +401,18 @@ class ContinuousBatchingEngine:
                     return
                 self._admit_locked()
             try:
-                self._step()
+                if _ttrace.enabled():
+                    with _ttrace.get_recorder().span(
+                            "engine.decode-tick", "serving",
+                            {"active": int(self._active.sum())},
+                            "serve-engine"):
+                        self._step()
+                else:
+                    self._step()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception("engine step failed")
                 self.step_failures += 1
+                _STEP_FAILURES.inc()
                 with self._cv:
                     for r in range(self.B):
                         if self._active[r]:
@@ -419,6 +448,7 @@ class ContinuousBatchingEngine:
             self.gen.params, tok, index, self._caches)
         self._logits = logits.astype(jnp.float32)
         self.decode_steps += 1
+        _DECODE_STEPS.inc()
 
         with self._cv:
             for r in range(self.B):
@@ -428,6 +458,9 @@ class ContinuousBatchingEngine:
                 cfg = item["cfg"]
                 t = int(nxt[r])
                 item["tokens"].append(t)
+                _TOKENS.inc()
+                if len(item["tokens"]) == 1 and "t_submit" in item:
+                    _TTFT.observe(time.monotonic() - item["t_submit"])
                 if item.get("on_token") is not None:
                     try:
                         item["on_token"](t)
@@ -442,3 +475,4 @@ class ContinuousBatchingEngine:
                     self._rows[r] = None
             # refill freed rows before the next tick
             self._admit_locked()
+            _ACTIVE_ROWS.set(int(self._active.sum()))

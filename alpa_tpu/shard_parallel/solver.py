@@ -30,6 +30,7 @@ from alpa_tpu.shard_parallel.ilp import (InfeasibleMemoryBudget,
                                          solution_cost, solve_strategy_graph)
 from alpa_tpu.shard_parallel.sharding_spec import spec_to_partition_spec
 from alpa_tpu.shard_parallel.strategy import build_strategy_graph
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -85,9 +86,12 @@ def plan_auto_sharding(fun: Callable,
         ])
         entry = cache.get("ilp", key)
         if entry is not None:
-            replayed = _replay_cached_solution(
-                closed_jaxpr, in_avals, batch_flat_idx, physical_mesh,
-                option, entry)
+            with _ttrace.span("ilp-cache-replay", "compile",
+                              {"cache": "hit"} if _ttrace.enabled()
+                              else None):
+                replayed = _replay_cached_solution(
+                    closed_jaxpr, in_avals, batch_flat_idx, physical_mesh,
+                    option, entry)
             if replayed is not None:
                 cache.record_saved_seconds(
                     "ilp", entry.get("solve_seconds", 0.0))
@@ -97,6 +101,10 @@ def plan_auto_sharding(fun: Callable,
                                       logical_mesh, graph, choice,
                                       return_graph)
 
+    solve_span = _ttrace.begin(
+        "ilp-solve", "compile",
+        {"cache": "miss" if cache is not None else "off"}
+        if _ttrace.enabled() else None)
     best = None
     tic = time.time()
     infeasible = None
@@ -106,8 +114,12 @@ def plan_auto_sharding(fun: Callable,
         graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
                                      batch_flat_idx, option)
         try:
-            choice = solve_strategy_graph(graph, option.solver_timeout,
-                                          option.memory_budget_per_device)
+            with _ttrace.span("ilp-solve-shape", "compile",
+                              {"shape": str(shape)} if _ttrace.enabled()
+                              else None):
+                choice = solve_strategy_graph(
+                    graph, option.solver_timeout,
+                    option.memory_budget_per_device)
         except InfeasibleMemoryBudget as e:
             # e.g. a (1, n) shape cannot shard a dim this shape could;
             # another candidate may still fit the budget
@@ -121,9 +133,11 @@ def plan_auto_sharding(fun: Callable,
         if best is None or cost < best[0]:
             best = (cost, shape, logical_mesh, graph, choice)
     if best is None:
+        _ttrace.end(solve_span)
         raise infeasible
     cost, shape, logical_mesh, graph, choice = best
     solve_seconds = time.time() - tic
+    _ttrace.end(solve_span)
     if global_config.print_compilation_time:
         logger.warning("auto-sharding search took %.2f s; picked %s "
                        "(cost %.4f)", solve_seconds, shape, cost)

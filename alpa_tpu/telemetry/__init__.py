@@ -1,0 +1,29 @@
+"""Unified telemetry layer (ISSUE 5).
+
+Two pillars:
+
+* :mod:`alpa_tpu.telemetry.trace` — thread-safe span tracing with
+  Chrome-trace (Perfetto) export.  Zero-cost when off.
+* :mod:`alpa_tpu.telemetry.metrics` — central Counter/Gauge/Histogram
+  registry with Prometheus text exposition; every ad-hoc stat in the
+  repo is a view over it.
+
+See docs/observability.md for the span model, category taxonomy and
+knob table (``ALPA_TPU_TRACE`` / ``ALPA_TPU_TRACE_DIR`` /
+``global_config.telemetry_*``).
+"""
+from alpa_tpu.telemetry.metrics import (       # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, DEFAULT_BUCKETS,
+    get_registry, reset_registry)
+from alpa_tpu.telemetry.trace import (         # noqa: F401
+    CATEGORIES, TraceRecorder, begin, counter, enabled, end,
+    get_recorder, instant, merge_chrome_traces, set_enabled,
+    set_recorder, span)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "DEFAULT_BUCKETS", "get_registry", "reset_registry",
+    "CATEGORIES", "TraceRecorder", "begin", "counter", "enabled",
+    "end", "get_recorder", "instant", "merge_chrome_traces",
+    "set_enabled", "set_recorder", "span",
+]

@@ -79,19 +79,38 @@ class Timers:
 
 class Tracer:
     """Timestamped event log, dumpable as a Chrome trace
-    (ref: alpa/timer.py:81-94 + pipeshard_executable.py:592)."""
+    (ref: alpa/timer.py:81-94 + pipeshard_executable.py:592).
+
+    .. deprecated::
+        Kept as a compatibility shim over the unified telemetry layer
+        (``alpa_tpu.telemetry``): when tracing is enabled, every
+        ``log()`` is mirrored into the process ``TraceRecorder`` as a
+        ``legacy``-category instant, so old call sites land in the same
+        merged Perfetto trace as span-instrumented code.  New code
+        should use ``telemetry.trace`` directly.
+    """
 
     def __init__(self):
         self.events = []
 
     def log(self, name: str, info: str = ""):
         self.events.append(TracerEvent(time.time(), name, info))
+        # bridge into the unified trace (no-op when tracing is off);
+        # imported lazily so ``alpa_tpu.timer`` stays importable alone
+        from alpa_tpu.telemetry import trace as _ttrace
+        if _ttrace.enabled():
+            _ttrace.instant(name, "legacy",
+                            {"info": info} if info else None)
 
     def clear(self):
         self.events = []
 
     def to_chrome_trace(self, pid: int = 0):
-        """Render events as Chrome trace 'instant' records."""
+        """Render events as Chrome trace 'instant' records.
+
+        .. deprecated:: prefer ``telemetry.trace.TraceRecorder.
+           to_chrome_trace()``, which carries spans and counters too.
+        """
         return [{
             "name": ev.name,
             "ph": "i",

@@ -23,14 +23,23 @@ reintroduced explicitly.  Under it the overlap replay hides most of the
 per-transfer idle time inside its in-flight window while the
 synchronous register replay pays it serially.
 
+A third section measures the unified telemetry layer (ISSUE 5): the
+same register-mode payload replayed with span tracing off vs on,
+recording the per-instruction overhead tracing adds (the
+zero-cost-when-off guard asserted by tests/runtime/test_telemetry.py).
+
 Writes ``benchmark/results/dispatch_modes.json`` with per-mode
 per-instruction latency, the speedup of the register path over both
 live interpreter runs and the committed 160.8 us/inst artifact
-baseline, and the reshard-heavy wall-clock comparison.
+baseline, the reshard-heavy wall-clock comparison, and the telemetry
+overhead section.
 
 Usage::
 
-    python benchmark/bench_dispatch.py [--steps N] [--out FILE]
+    python benchmark/bench_dispatch.py [--steps N] [--out FILE] [--trace]
+
+``--trace`` additionally saves the tracing-on run's merged Chrome trace
+to ``benchmark/results/dispatch_trace.json`` (Perfetto-loadable).
 """
 import argparse
 import json
@@ -185,18 +194,93 @@ def run_reshard_heavy(n_steps: int = 5,
     }
 
 
+def run_telemetry_overhead(n_steps: int = 8,
+                           trace_out: "str | None" = None):
+    """Register-mode per-instruction latency with span tracing off vs
+    on (same payload as ``run_modes``).  The off number exercises the
+    disabled fast path (one ``enabled()`` check per step); the on
+    number pays a span per instruction.  ``trace_out`` saves the
+    traced run's Chrome trace."""
+    import alpa_tpu
+    from alpa_tpu import PipeshardParallel
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.telemetry import trace as ttrace
+    from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    alpa_tpu.init(cluster="local")
+    prev_mode = global_config.pipeline_dispatch_mode
+    global_config.pipeline_dispatch_mode = "registers"
+    method = PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=AutoLayerOption(layer_num=8),
+        stage_option=UniformStageOption(num_stages=8))
+    step = get_mlp_train_step(method, use_value_and_grad=True)
+    state, batch = create_mlp_train_state_and_batch(
+        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+        num_layers=8)
+    state, loss = step(state, batch)   # compile + lower
+    float(loss)
+    ex = step.get_last_executable()
+
+    def best_per_inst(state):
+        best = None
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            float(loss)
+            st = dict(ex.last_dispatch_stats)
+            if best is None or st["per_inst_us"] < best["per_inst_us"]:
+                best = st
+        return best["per_inst_us"], state
+
+    try:
+        off_us, state = best_per_inst(state)
+        prev_enabled = ttrace.set_enabled(True)
+        try:
+            ttrace.get_recorder().clear()
+            on_us, state = best_per_inst(state)
+            if trace_out is not None:
+                ttrace.get_recorder().save(trace_out)
+        finally:
+            ttrace.set_enabled(prev_enabled)
+    finally:
+        global_config.pipeline_dispatch_mode = prev_mode
+
+    return {
+        "payload": "registers mode, same dispatch payload as 'modes'",
+        "tracing_off_per_inst_us": off_us,
+        "tracing_on_per_inst_us": on_us,
+        "tracing_overhead_fraction": on_us / off_us - 1.0,
+        "trace_file": (os.path.relpath(trace_out, REPO)
+                       if trace_out else None),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--steps", type=int, default=8,
                         help="timed steps per mode (best-of is reported)")
     parser.add_argument("--out", default=os.path.join(
         REPO, "benchmark", "results", "dispatch_modes.json"))
+    parser.add_argument("--trace", action="store_true",
+                        help="save the tracing-on run's Chrome trace to "
+                             "benchmark/results/dispatch_trace.json")
     args = parser.parse_args()
 
     from alpa_tpu.platform import pin_cpu_platform
     pin_cpu_platform(8)
+    trace_out = None
+    if args.trace:
+        trace_out = os.path.join(
+            REPO, "benchmark", "results", "dispatch_trace.json")
+        os.makedirs(os.path.dirname(trace_out), exist_ok=True)
     report = run_modes(args.steps)
     report["reshard_heavy"] = run_reshard_heavy(args.steps)
+    report["telemetry"] = run_telemetry_overhead(args.steps,
+                                                 trace_out=trace_out)
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)

@@ -1,0 +1,158 @@
+"""Work with unified telemetry traces (alpa_tpu.telemetry, ISSUE 5).
+
+Usage::
+
+    python scripts/trace_tool.py record  OUT.json -- CMD [ARGS...]
+    python scripts/trace_tool.py merge   OUT.json TRACE.json [TRACE.json...]
+    python scripts/trace_tool.py summarize TRACE.json [--top N]
+    python scripts/trace_tool.py top     TRACE.json [--top N]
+
+``record`` runs CMD as a child process with ``ALPA_TPU_TRACE=1`` and
+``ALPA_TPU_TRACE_DIR`` pointed at a scratch dir, then merges whatever
+trace files the run saved into OUT.json; ``merge`` combines per-mesh /
+per-process trace files onto distinct pids (each input keeps its own
+track group in Perfetto); ``summarize`` prints total time per category
+plus the longest individual spans; ``top`` aggregates spans by name
+(hottest instructions first).  All outputs load directly in
+https://ui.perfetto.dev.
+"""
+import argparse
+import collections
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.telemetry.trace import merge_chrome_traces  # noqa: E402
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        sys.exit(f"{path}: not a chrome trace (no traceEvents)")
+    return trace
+
+
+def _duration_events(trace):
+    """Complete spans as (name, category, dur_us) from B/E pairs."""
+    open_stacks = collections.defaultdict(list)
+    spans = []
+    events = sorted(
+        (e for e in trace["traceEvents"] if e.get("ph") in ("B", "E")),
+        key=lambda e: (e["ts"], 0 if e["ph"] == "E" else 1))
+    for e in events:
+        key = (e.get("pid", 0), e.get("tid", 0))
+        if e["ph"] == "B":
+            open_stacks[key].append(e)
+        elif open_stacks[key]:
+            b = open_stacks[key].pop()
+            spans.append((b["name"], b.get("cat", ""), e["ts"] - b["ts"]))
+    return spans
+
+
+def cmd_record(args):
+    if not args.cmd:
+        sys.exit("record needs a command: trace_tool.py record OUT -- CMD")
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    with tempfile.TemporaryDirectory(prefix="alpa-trace-") as scratch:
+        env = dict(os.environ,
+                   ALPA_TPU_TRACE="1",
+                   ALPA_TPU_TRACE_DIR=scratch)
+        ret = subprocess.call(cmd, env=env)
+        traces = sorted(
+            os.path.join(scratch, f) for f in os.listdir(scratch)
+            if f.endswith(".json"))
+        if not traces:
+            sys.exit(f"command exited {ret} but saved no trace files "
+                     f"into ALPA_TPU_TRACE_DIR ({scratch})")
+        merged = merge_chrome_traces([_load(p) for p in traces])
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(merged, f)
+        print(f"merged {len(traces)} trace file(s) -> {args.out} "
+              f"({len(merged['traceEvents'])} events)")
+    if ret:
+        sys.exit(ret)
+
+
+def cmd_merge(args):
+    traces = [_load(p) for p in args.traces]
+    merged = merge_chrome_traces(traces)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(merged, f)
+    print(f"merged {len(traces)} trace file(s) -> {args.out} "
+          f"({len(merged['traceEvents'])} events)")
+
+
+def cmd_summarize(args):
+    trace = _load(args.trace)
+    spans = _duration_events(trace)
+    if not spans:
+        print("no complete spans in trace")
+        return
+    per_cat = collections.Counter()
+    for _name, cat, dur in spans:
+        per_cat[cat or "(none)"] += dur
+    print(f"{len(spans)} spans, "
+          f"{len(trace['traceEvents'])} raw events")
+    print(f"\n{'category':<16} {'total ms':>12} {'share':>7}")
+    total = sum(per_cat.values()) or 1.0
+    for cat, us in per_cat.most_common():
+        print(f"{cat:<16} {us / 1e3:>12.3f} {us / total:>6.1%}")
+    print(f"\ntop {args.top} longest spans:")
+    for name, cat, dur in sorted(spans, key=lambda s: -s[2])[:args.top]:
+        print(f"  {dur / 1e3:>10.3f} ms  [{cat}] {name}")
+
+
+def cmd_top(args):
+    trace = _load(args.trace)
+    spans = _duration_events(trace)
+    if not spans:
+        print("no complete spans in trace")
+        return
+    agg = collections.defaultdict(lambda: [0, 0.0])
+    for name, _cat, dur in spans:
+        agg[name][0] += 1
+        agg[name][1] += dur
+    print(f"{'total ms':>12} {'count':>7} {'avg ms':>10}  name")
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1][1])[:args.top]
+    for name, (n, us) in ranked:
+        print(f"{us / 1e3:>12.3f} {n:>7} {us / n / 1e3:>10.3f}  {name}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("record", help="run CMD traced, merge its traces")
+    pr.add_argument("out")
+    pr.add_argument("cmd", nargs=argparse.REMAINDER)
+    pr.set_defaults(func=cmd_record)
+
+    pm = sub.add_parser("merge", help="merge trace files onto one timeline")
+    pm.add_argument("out")
+    pm.add_argument("traces", nargs="+")
+    pm.set_defaults(func=cmd_merge)
+
+    ps = sub.add_parser("summarize", help="per-category totals + top spans")
+    ps.add_argument("trace")
+    ps.add_argument("--top", type=int, default=10)
+    ps.set_defaults(func=cmd_summarize)
+
+    pt = sub.add_parser("top", help="hottest span names")
+    pt.add_argument("trace")
+    pt.add_argument("--top", type=int, default=20)
+    pt.set_defaults(func=cmd_top)
+
+    args = p.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()
