@@ -234,6 +234,12 @@ def fire(site: str, **info):
         spec = plan._consume(site, info)
         if spec is None:
             continue
+        # a firing site is one of the flight recorder's auto-dump
+        # triggers (ISSUE 6): capture the instruction timeline leading
+        # up to the injection before the failure propagates.  Lazy
+        # import: fault.py must stay importable without telemetry.
+        from alpa_tpu.telemetry import flight as _flight
+        _flight.auto_dump(f"fault site fired: {site} ({spec.kind})")
         if spec.kind == "error":
             exc = spec.exc() if spec.exc is not None else InjectedFault(
                 f"injected fault at {site} ({info})")
@@ -513,6 +519,12 @@ class RecoveryManager:
         _STATE_TRANSITIONS.labels(new.value).inc()
         logger.warning("mesh health: %s -> %s (%s)", old.value,
                        new.value, reason)
+        if new is MeshHealth.SUSPECT:
+            # watchdog declared a mesh SUSPECT: dump the flight ring —
+            # the last instructions dispatched before liveness broke are
+            # exactly the post-mortem a hang needs (ISSUE 6)
+            from alpa_tpu.telemetry import flight as _flight
+            _flight.auto_dump(f"mesh SUSPECT: {reason}")
         self._call(self.on_state_change, old, new)
 
     @staticmethod

@@ -75,20 +75,27 @@ class GlobalConfig:
         # runtime_emitter's per-worker lists); "auto" picks overlap when
         # eligible (register-eligible AND multi-mesh with cross-mesh
         # RESHARDs AND overlap_resharding), else registers when eligible
-        # (single process, device_put resharding, no fault/trace/
-        # race instrumentation), and falls back to the interpreter
-        # otherwise.  Multi-process always dispatches sequentially:
-        # collectives must be issued in the same order on every process.
+        # (single process, device_put resharding), and falls back to the
+        # interpreter otherwise.  Tracing, fault injection, and race
+        # checking do NOT change the mode: they compile in as per-node
+        # hooks on the graph executor (ISSUE 6), so instrumented runs
+        # execute the same fast path.  Multi-process always dispatches
+        # sequentially: collectives must be issued in the same order on
+        # every process.
         self.pipeline_dispatch_mode = os.environ.get(
             "ALPA_TPU_PIPELINE_DISPATCH", "auto")
-        # Runtime race detection for threaded dispatch: every worker
-        # reports its instruction's value accesses; cross-stream
-        # conflicting overlap (a partitioner dependency bug) raises
-        # instead of corrupting numerics.  Debug tool — adds a lock
-        # round-trip per instruction.
+        # Runtime race detection: threaded dispatch reports every
+        # worker's instruction accesses through DispatchRaceChecker;
+        # register/overlap replay arms the SlotHazardChecker graph-node
+        # hook (slot read/write/free conflicts against in-flight
+        # transfers).  A detected race (a partitioner dependency bug)
+        # raises instead of corrupting numerics.  Debug tool — adds
+        # per-instruction bookkeeping.
         self.debug_dispatch_races = _env_bool(
             "ALPA_TPU_DEBUG_DISPATCH_RACES", False)
-        # Collect timing trace events on the instruction interpreter hot loop.
+        # Collect per-instruction trace events on the dispatch hot loop
+        # (any mode — recorded via the unified telemetry recorder and
+        # exported by dump_stage_execution_trace).
         self.collect_trace = _env_bool("ALPA_TPU_COLLECT_TRACE", False)
         # Use dummy data for benchmarking (skip real input transfer).
         self.use_dummy_value_for_benchmarking = _env_bool(
@@ -156,6 +163,20 @@ class GlobalConfig:
         # in the exported trace instead of growing without bound.
         self.telemetry_max_events = int(os.environ.get(
             "ALPA_TPU_TRACE_MAX_EVENTS", "200000"))
+        # Flight recorder (alpa_tpu/telemetry/flight.py): fixed-size
+        # lock-free ring of the last N instruction events, auto-dumped
+        # when a step raises, a fault site fires, or the watchdog
+        # declares a mesh SUSPECT.  Cheap enough to leave on in
+        # production (one counter bump + one tuple store per
+        # instruction), hence default True.
+        self.flight_recorder = _env_bool("ALPA_TPU_FLIGHT", True)
+        # Ring capacity (instruction events retained); rounded up to a
+        # power of two.
+        self.flight_recorder_capacity = int(os.environ.get(
+            "ALPA_TPU_FLIGHT_CAPACITY", "4096"))
+        # Where auto-dumps land.  None = dump_debug_info_dir, else the
+        # system temp dir.
+        self.flight_dump_dir = os.environ.get("ALPA_TPU_FLIGHT_DIR", None)
 
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS

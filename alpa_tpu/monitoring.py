@@ -185,6 +185,14 @@ def dump_debug_info(executable, dump_dir: str):
         rec = _ttrace.get_recorder()
         if rec.n_events:
             rec.save(os.path.join(dump_dir, "trace.json"))
+    # flight recorder ring (ISSUE 6): the last N instruction events —
+    # the post-mortem timeline `scripts/trace_tool.py flight` reads
+    from alpa_tpu.telemetry import flight as _flight
+    if _flight.enabled():
+        frec = _flight.get_recorder()
+        if frec.n_events:
+            frec.dump(os.path.join(dump_dir, "flight.json"),
+                      reason="dump_debug_info")
     logger.info("debug info dumped to %s", dump_dir)
 
 

@@ -10,6 +10,7 @@ asynchronous, so consecutive RUNs on different meshes overlap on device —
 the single Python loop plays the role of the reference's per-host
 interpreter loops (``execute_on_worker``, ref pipeshard_executable.py:489).
 """
+import contextlib
 import itertools
 import logging
 import threading
@@ -30,6 +31,7 @@ from alpa_tpu.pipeline_parallel.runtime_emitter import (
     PlacementSpecEntry, emit_free_instructions, partition_streams)
 from alpa_tpu.pipeline_parallel.schedules import create_pipeline_schedule
 from alpa_tpu.shard_parallel.auto_sharding import MESH_AXIS_NAMES
+from alpa_tpu.telemetry import flight as _flight
 from alpa_tpu.telemetry import trace as _ttrace
 from alpa_tpu.timer import timers, tracer
 from alpa_tpu.util import OrderedSet
@@ -599,6 +601,11 @@ class PipeshardDriverExecutable:
         step_span = _ttrace.begin("pipeshard.step", "runtime")
         try:
             return self._launch(*flat_args)
+        except BaseException:
+            # post-mortem timeline of the instructions leading up to the
+            # failure (no-op when the ring is empty or already dumped)
+            _flight.auto_dump("pipeshard step raised")
+            raise
         finally:
             _ttrace.end(step_span)
             timer.stop()
@@ -642,22 +649,21 @@ class PipeshardDriverExecutable:
                 f"or 'planned', got {exec_mode!r}")
         multiprocess = jax.process_count() > 1
         # Register-file replay fast path (ISSUE 2): the lowered program
-        # does no dict hashing / sharding resolution per call, but cannot
-        # carry fault hooks, trace collection, race checking, planned
-        # resharding, or the multi-process collective-order contract —
-        # those launches take the interpreter below.
+        # does no dict hashing / sharding resolution per call.  Fault
+        # sites, trace collection, and race checking are NOT exclusions
+        # (ISSUE 6): they compile in as per-node hooks on the graph
+        # executor, so instrumented launches run the same fast path.
+        # Only planned resharding and the multi-process collective-order
+        # contract still take the interpreter below.
         dmode = getattr(global_config, "pipeline_dispatch_mode", "auto")
-        reg_ok = (not multiprocess and exec_mode == "device_put" and
-                  not fault.instrumented() and
-                  not global_config.collect_trace and
-                  not global_config.debug_dispatch_races)
+        reg_ok = not multiprocess and exec_mode == "device_put"
         if dmode in ("registers", "overlap") and not reg_ok and \
                 not self._warned_register_fallback:
             self._warned_register_fallback = True
             logger.warning(
                 "pipeline_dispatch_mode=%r requested but the "
-                "launch is not eligible (multiprocess, planned resharding, "
-                "fault/trace/race instrumentation); falling back to the "
+                "launch is not eligible (multiprocess or planned "
+                "resharding); falling back to the "
                 "instruction interpreter", dmode)
         # overlap mode (ISSUE 4): replay the dataflow graph with eager
         # async cross-mesh transfers.  Eligible when the register path is
@@ -766,9 +772,9 @@ class PipeshardDriverExecutable:
         if use_threads:
             self._run_streams_threaded(ctx)
         else:
-            for inst in self.instructions:
+            for inst_idx, inst in enumerate(self.instructions):
                 inst_tic = time.perf_counter()
-                self._exec_inst(inst, ctx)
+                self._exec_inst(inst, ctx, inst_idx)
                 s = stats[inst.opcode.name]
                 s[0] += 1
                 s[1] += time.perf_counter() - inst_tic
@@ -989,6 +995,9 @@ class PipeshardDriverExecutable:
             "loop_s": loop_s,
             "per_inst_us": loop_s / n_inst * 1e6,
             "mode": prog.mode,
+            # hook families compiled into this replay ("trace"/"fault"/
+            # "race"/"flight"; empty = raw closures, zero added branches)
+            "hooks": prog.last_hooks,
             "by_opcode": {k: {"n": v, "s": 0.0}
                           for k, v in prog.by_opcode.items()},
         }
@@ -1038,25 +1047,47 @@ class PipeshardDriverExecutable:
                         "num_micro_batches=1.")
         return outs
 
-    def _exec_inst(self, inst, ctx):
+    def _exec_inst(self, inst, ctx, idx: int = -1):
         """Execute one pipeline instruction (shared by the sequential loop
-        and the per-stream worker threads)."""
-        if _ttrace.enabled():
-            # per-instruction span on the destination mesh's track (the
-            # interpreter analog of the register replay's op_meta spans)
-            opname = inst.opcode.name
-            mesh = (inst.free_keys[0][2]
-                    if opname == "FREE" and inst.free_keys
-                    else inst.dst_mesh)
-            with _ttrace.get_recorder().span(
-                    (f"{opname} {inst.info}" if inst.info else opname),
-                    "instruction", None, f"mesh {mesh}"):
+        and the per-stream worker threads).  ``idx`` is the global
+        instruction index, recorded in flight-recorder events."""
+        collect = ctx[4]
+        # per-instruction span on the destination mesh's track (the
+        # interpreter analog of the register replay's op_meta spans).
+        # collect_trace records through the recorder even when the
+        # telemetry master switch is off — same contract as the graph
+        # executor's trace hook — feeding dump_stage_execution_trace.
+        trace_on = _ttrace.enabled() or collect
+        flight_on = _flight.enabled()
+        if not (trace_on or flight_on):
+            self._exec_inst_inner(inst, ctx)
+            return
+        opname = inst.opcode.name
+        mesh = (inst.free_keys[0][2]
+                if opname == "FREE" and inst.free_keys
+                else inst.dst_mesh)
+        name = f"{opname} {inst.info}" if inst.info else opname
+        span = (_ttrace.get_recorder().span(
+                    name, "instruction", None, f"mesh {mesh}")
+                if trace_on else contextlib.nullcontext())
+        if not flight_on:
+            with span:
                 self._exec_inst_inner(inst, ctx)
             return
-        self._exec_inst_inner(inst, ctx)
+        rec = _flight.get_recorder()
+        t0 = _flight.now_us()
+        try:
+            with span:
+                self._exec_inst_inner(inst, ctx)
+        except BaseException as e:
+            rec.record("exec", name, mesh, idx, (), t0, _flight.now_us(),
+                       f"error:{type(e).__name__}")
+            raise
+        rec.record("exec", name, mesh, idx, (), t0, _flight.now_us(),
+                   "ok")
 
     def _exec_inst_inner(self, inst, ctx):
-        env, _put, exec_mode, mp_planned, collect, _stats = ctx
+        env, _put, exec_mode, mp_planned, _collect, _stats = ctx
         if inst.opcode == PipelineInstType.RUN:
             exec_ = inst.executable
             args = [env[k][inst.dst_mesh] for k in inst.input_keys]
@@ -1085,8 +1116,6 @@ class PipeshardDriverExecutable:
                 outs = exec_.compiled(*args)
             for k, o in zip(inst.output_keys, outs):
                 env.setdefault(k, {})[inst.dst_mesh] = o
-            if collect:
-                tracer.log("RUN", inst.info)
         elif inst.opcode == PipelineInstType.RESHARD:
             val = env[inst.var_key][inst.src_mesh]
 
@@ -1135,8 +1164,6 @@ class PipeshardDriverExecutable:
                                       idempotent=not mp_planned)
             else:
                 transfer()
-            if collect:
-                tracer.log("RESHARD", inst.info)
         else:  # FREE
             for (v, i, m) in inst.free_keys:
                 d = env.get((v, i))
@@ -1188,7 +1215,7 @@ class PipeshardDriverExecutable:
                     accs = checker.begin(idx) if checker else None
                     tic = time.perf_counter()
                     try:
-                        self._exec_inst(inst, ctx)
+                        self._exec_inst(inst, ctx, idx)
                     finally:
                         if checker:
                             checker.end(idx, accs)
@@ -1263,20 +1290,34 @@ class PipeshardDriverExecutable:
         return hashlib.sha256(text.encode()).hexdigest()
 
     def dump_stage_execution_trace(self, filename: str):
-        """Write the collected tracer events as a Chrome trace JSON
-        (ref dump_stage_execution_trace_internal,
-        pipeshard_executable.py:592).  Events come from the process-global
-        tracer: run one executable at a time between tracer.clear() calls
-        to attribute events.  Requires global_config.collect_trace=True
-        during execution (warned if the trace is empty)."""
+        """Write the collected per-instruction events as a Chrome trace
+        JSON (ref dump_stage_execution_trace_internal,
+        pipeshard_executable.py:592).
+
+        Events come from the unified ``telemetry.trace`` recorder — the
+        same spans every dispatch mode records (interpreter per-inst
+        spans, register/overlap ``op_meta`` hook spans) — plus whatever
+        legacy ``timer.Tracer`` instants third-party code still logs.
+        Run one executable at a time between ``recorder.clear()`` calls
+        to attribute events.  Requires ``global_config.collect_trace``
+        (or the telemetry master switch) to be True during execution;
+        warns with the active dispatch mode when empty."""
         import json
-        events = tracer.to_chrome_trace()
-        if not events:
+        all_events = _ttrace.get_recorder().to_chrome_trace().get(
+            "traceEvents", [])
+        # "M" records are per-track metadata the recorder always emits;
+        # real content is spans/instants/counters
+        timed = [e for e in all_events if e.get("ph") != "M"]
+        legacy = tracer.to_chrome_trace()
+        if not timed and not legacy:
+            mode = (getattr(self, "last_dispatch_stats", None)
+                    or {}).get("mode")
             logger.warning(
-                "dump_stage_execution_trace: no events collected — set "
-                "global_config.collect_trace = True before running")
+                "dump_stage_execution_trace: no events collected (last "
+                "dispatch mode: %s) — set global_config.collect_trace = "
+                "True before running", mode)
         with open(filename, "w", encoding="utf-8") as f:
-            json.dump({"traceEvents": events}, f)
+            json.dump({"traceEvents": all_events + legacy}, f)
 
     def get_resharding_report(self) -> str:
         """Planned cross-mesh traffic per step (tile-level accounting from

@@ -33,6 +33,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from jax.extend.core import Var
 
+from alpa_tpu import fault as _fault
+from alpa_tpu.global_env import global_config
+from alpa_tpu.telemetry import flight as _flight
 from alpa_tpu.telemetry import metrics as _tmetrics
 from alpa_tpu.telemetry import trace as _ttrace
 
@@ -345,6 +348,78 @@ class InstructionDataflowGraph:
     def n_cross_mesh(self) -> int:
         return sum(1 for n_ in self.nodes if n_.cross_mesh)
 
+    def check(self) -> None:
+        """Static lowering-time hazard pass (ISSUE 6): independently
+        re-derive every slot hazard with a forward walk and assert the
+        graph's ``preds`` cover it.  Runs on EVERY compile (called by
+        :func:`lower_to_register_file`), not just under debug — it is
+        O(edges) over an in-memory list, so the cost is lowering noise.
+
+        Catches the bug class where an edit to :meth:`build`, the
+        lowering, or a hand-constructed graph drops a dependency edge:
+        a reader without an edge to its slot's last writer (RAW), a
+        writer/killer without edges to the previous writer (WAW) or to
+        readers since (write-after-read on a live slot), a FREE/kill of
+        a cross-mesh transfer destination with no edge to the transfer
+        (the in-flight-FREE hazard the overlap replay relies on), a
+        forward-pointing edge (deadlock risk), or a node list whose
+        positions disagree with node indices.
+        """
+        nodes = self.nodes
+        problems: List[str] = []
+        for i, node in enumerate(nodes):
+            if node.idx != i:
+                problems.append(
+                    f"node at position {i} carries idx {node.idx}")
+        last_writer: Dict[int, int] = {}
+        readers_since: Dict[int, List[int]] = {}
+        for node in nodes:
+            if len(problems) > 20:
+                break
+            i = node.idx
+            preds = set(self.preds[i]) if i < len(self.preds) else set()
+            for p in preds:
+                if p >= i:
+                    problems.append(
+                        f"node {i} ({node.kind}) has a non-backward "
+                        f"edge to node {p}")
+            for s in node.reads:
+                w = last_writer.get(s)
+                if w is not None and w != i and w not in preds:
+                    problems.append(
+                        f"RAW hazard: node {i} ({node.kind}) reads slot "
+                        f"{s} with no edge to its writer, node {w}")
+                readers_since.setdefault(s, []).append(i)
+            for s in tuple(node.writes) + tuple(node.kills):
+                kill = s in node.kills
+                verb = "kills" if kill else "writes"
+                w = last_writer.get(s)
+                if w is not None and w != i and w not in preds:
+                    if kill and nodes[w].cross_mesh:
+                        problems.append(
+                            f"FREE of an in-flight transfer destination:"
+                            f" node {i} ({node.kind}) kills slot {s} "
+                            f"with no edge to cross-mesh transfer node "
+                            f"{w}")
+                    else:
+                        problems.append(
+                            f"WAW hazard: node {i} ({node.kind}) {verb} "
+                            f"slot {s} with no edge to its previous "
+                            f"writer, node {w}")
+                for r in readers_since.get(s, ()):
+                    if r != i and r not in preds:
+                        problems.append(
+                            f"write-after-read on a live slot: node {i} "
+                            f"({node.kind}) {verb} slot {s} with no "
+                            f"edge to its reader, node {r}")
+                readers_since[s] = []
+                last_writer[s] = i
+        if problems:
+            raise RuntimeError(
+                "instruction dataflow graph failed the static hazard "
+                "check (a dependency edge is missing or malformed):\n  "
+                + "\n  ".join(problems[:20]))
+
 
 def schedule_overlap(graph: InstructionDataflowGraph, window: int
                      ) -> Tuple[List[Tuple[str, int]], int]:
@@ -453,6 +528,167 @@ def _equiv_shardings(s1, s2, ndim) -> bool:
         return s1 == s2
 
 
+########################################
+# per-node hook points (ISSUE 6 tentpole)
+########################################
+
+
+@dataclasses.dataclass(frozen=True)
+class OpHook:
+    """One op's hook point, compiled into the replay plan at lowering
+    time (ISSUE 6).  The hook is pure metadata: which dataflow node the
+    op replays, its slot footprint, and which fault site the
+    interpreter would have fired for it.  At execute time, when any
+    instrumentation is active, :meth:`RegisterFileProgram.execute`
+    compiles a wrapped op list from these — tracing spans, flight
+    recorder events, slot-hazard assertions, fault-site checks — and
+    replays that; with everything off the raw closures run with zero
+    added branches.
+
+    A batched group op carries the union slot footprint and one fault
+    info dict per member, so FaultSpec hit counts match the
+    interpreter's per-instruction fires exactly.
+    """
+    kind: str                             # "exec" | "launch" | "wait"
+    name: str                             # span/event label
+    node: int                             # dataflow node idx (group: first)
+    mesh: int
+    reads: Tuple[int, ...] = ()
+    writes: Tuple[int, ...] = ()
+    kills: Tuple[int, ...] = ()
+    slots: Tuple[int, ...] = ()           # union footprint (flight events)
+    fault_site: Optional[str] = None      # fault.py site name
+    fault_infos: Tuple[Any, ...] = ()     # one info dict per member
+    idempotent: bool = True               # retry semantics (donation)
+
+
+class SlotHazardChecker:
+    """Graph-node flavor of the dispatch race checker (ISSUE 6): the
+    threaded interpreter's :class:`DispatchRaceChecker` validates
+    cross-stream value accesses; this validates the register/overlap
+    replay's slot accesses against in-flight transfers at replay time.
+
+    The overlap schedule promises that between a transfer's launch and
+    its wait, nothing reads the destination slot, writes either
+    endpoint slot, or donates/frees them.  With
+    ``global_config.debug_dispatch_races`` on, every op's hook reports
+    its footprint here; a violation means the dataflow graph or the
+    scheduler failed to serialize the pair — the bug class that would
+    otherwise surface as a torn read of a ``_PendingTransfer`` or a
+    use-after-free far from its cause.  Driver-thread only (hooks run
+    on the dispatch thread), so no lock is needed.
+    """
+
+    def __init__(self):
+        self._inflight_src: Dict[int, int] = {}   # slot -> launch node
+        self._inflight_dst: Dict[int, int] = {}
+        self.violations: List[str] = []
+
+    def begin_step(self):
+        self._inflight_src.clear()
+        self._inflight_dst.clear()
+        self.violations = []
+
+    def on_launch(self, hook: OpHook):
+        for s in hook.reads:
+            self._inflight_src[s] = hook.node
+        for s in hook.writes:
+            self._inflight_dst[s] = hook.node
+
+    def on_wait(self, hook: OpHook):
+        for s in hook.reads:
+            self._inflight_src.pop(s, None)
+        for s in hook.writes:
+            self._inflight_dst.pop(s, None)
+
+    def on_exec(self, hook: OpHook):
+        for s in hook.reads:
+            n = self._inflight_dst.get(s)
+            if n is not None:
+                self.violations.append(
+                    f"{hook.name} (node {hook.node}) reads slot {s} "
+                    f"still owned by in-flight transfer node {n}")
+        for s in hook.writes:
+            for owners, role in ((self._inflight_src, "source"),
+                                 (self._inflight_dst, "destination")):
+                n = owners.get(s)
+                if n is not None:
+                    self.violations.append(
+                        f"{hook.name} (node {hook.node}) writes slot "
+                        f"{s}, the {role} of in-flight transfer node "
+                        f"{n}")
+        for s in hook.kills:
+            for owners, role in ((self._inflight_src, "source"),
+                                 (self._inflight_dst, "destination")):
+                n = owners.get(s)
+                if n is not None:
+                    self.violations.append(
+                        f"{hook.name} (node {hook.node}) frees/donates "
+                        f"slot {s}, the {role} of in-flight transfer "
+                        f"node {n}")
+
+    def check(self):
+        if self.violations:
+            raise RuntimeError(
+                "register/overlap replay raced an in-flight transfer "
+                "(graph schedule failed to serialize slot accesses):"
+                "\n  " + "\n  ".join(self.violations[:10]))
+
+
+def _wrap_fault(op, hook: OpHook):
+    """Fault-site hook: fire every member's site before the op, retry
+    under the site policy — same semantics (and same FaultSpec hit
+    counts) as the interpreter's per-instruction wrapping."""
+    def wrapped(regs, _op=op, _site=hook.fault_site,
+                _infos=hook.fault_infos, _idem=hook.idempotent):
+        def attempt():
+            for info in _infos:
+                _fault.fire(_site, **info)
+            _op(regs)
+        _fault.call_with_retry(attempt, site=_site, idempotent=_idem)
+    return wrapped
+
+
+def _wrap_hazard(op, hook: OpHook, checker: SlotHazardChecker):
+    if hook.kind == "launch":
+        def wrapped(regs, _op=op, _h=hook, _c=checker):
+            _c.on_launch(_h)
+            _op(regs)
+    elif hook.kind == "wait":
+        def wrapped(regs, _op=op, _h=hook, _c=checker):
+            _op(regs)
+            _c.on_wait(_h)
+    else:
+        def wrapped(regs, _op=op, _h=hook, _c=checker):
+            _c.on_exec(_h)
+            _op(regs)
+    return wrapped
+
+
+def _wrap_flight(op, hook: OpHook, rec):
+    """Flight-recorder hook: one ring event per op, outcome included —
+    the op's exception (if any) is re-raised after recording."""
+    def wrapped(regs, _op=op, _rec=rec.record, _now=_flight.now_us,
+                _k=hook.kind, _n=hook.name, _m=hook.mesh,
+                _nd=hook.node, _s=hook.slots):
+        t0 = _now()
+        try:
+            _op(regs)
+        except BaseException as e:  # noqa: B036 — record, then re-raise
+            _rec(_k, _n, _m, _nd, _s, t0, _now(),
+                 f"error:{type(e).__name__}")
+            raise
+        _rec(_k, _n, _m, _nd, _s, t0, _now(), "ok")
+    return wrapped
+
+
+def _wrap_trace(op, name, cat, track, rec):
+    def wrapped(regs, _op=op, _span=rec.span, _n=name, _c=cat, _t=track):
+        with _span(_n, _c, None, _t):
+            _op(regs)
+    return wrapped
+
+
 @dataclasses.dataclass
 class RegisterFileProgram:
     """The instruction list lowered to a flat register file (ISSUE 2).
@@ -489,11 +725,30 @@ class RegisterFileProgram:
     # lowering time; only consulted when tracing is on — the hot replay
     # checks the enabled flag ONCE per step, not per op.
     op_meta: Optional[List[Tuple[str, str, str]]] = None
+    # hook points (ISSUE 6): per-op OpHook metadata built at lowering
+    # time.  None (synthetic/legacy programs) keeps the pre-hook
+    # execute() path byte for byte.
+    hooks: Optional[List[OpHook]] = None
+    # which hook families ran last step (stats/debugging)
+    last_hooks: Tuple[str, ...] = ()
+    # compiled wrapped-op cache, keyed by the active-hook signature
+    _hook_sig: Any = dataclasses.field(default=None, init=False,
+                                       repr=False, compare=False)
+    _hooked_ops: Optional[List[Any]] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False)
+    _hazard: Optional[SlotHazardChecker] = dataclasses.field(
+        default=None, init=False, repr=False, compare=False)
 
     def execute(self, regs: List[Any]):
         rs = self.run_stats
         rs["transfer_busy_s"] = 0.0
         rs["wait_blocked_s"] = 0.0
+        if self.hooks is not None:
+            sig = self._active_hook_signature()
+            if sig is not None:
+                self._execute_hooked(regs, sig)
+                return
+            self.last_hooks = ()
         if _ttrace.enabled():
             self._execute_traced(regs)
             return
@@ -510,6 +765,73 @@ class RegisterFileProgram:
         for op, (name, cat, track) in zip(self.ops, meta):
             with rec.span(name, cat, None, track):
                 op(regs)
+
+    # ---- hook compilation (ISSUE 6) ---------------------------------
+
+    def _active_hook_signature(self):
+        """One cheap per-step check deciding whether (and how) the op
+        list must be replayed hooked.  None = nothing active: the raw
+        closures run with zero added branches, preserving the <2%
+        disabled-overhead guard.  Recorder identities are part of the
+        signature because tests (and trace_tool record) swap recorders
+        mid-process via set_recorder."""
+        trace_on = _ttrace.enabled() or global_config.collect_trace
+        fault_on = _fault.instrumented()
+        race_on = global_config.debug_dispatch_races
+        flight_on = _flight.enabled()
+        if not (trace_on or fault_on or race_on or flight_on):
+            return None
+        return (trace_on,
+                id(_ttrace.get_recorder()) if trace_on else 0,
+                fault_on, race_on, flight_on,
+                id(_flight.get_recorder()) if flight_on else 0)
+
+    def _execute_hooked(self, regs: List[Any], sig):
+        if sig != self._hook_sig:
+            self._hooked_ops, self._hazard = self._compile_hooks(sig)
+            self._hook_sig = sig
+        self.last_hooks = tuple(
+            name for on, name in zip(
+                (sig[0], sig[2], sig[3], sig[4]),
+                ("trace", "fault", "race", "flight")) if on)
+        hz = self._hazard
+        if hz is not None:
+            hz.begin_step()
+        for op in self._hooked_ops:
+            op(regs)
+        if hz is not None:
+            hz.check()
+
+    def _compile_hooks(self, sig):
+        """Build the wrapped-op list for the active instrumentation.
+        Wrapper nesting, outermost first: trace span > flight event >
+        hazard check > fault site — so a fault retry re-fires inside
+        one span, and the flight event's outcome reflects the final
+        (post-retry) result."""
+        trace_on, _tid, fault_on, race_on, flight_on, _fid = sig
+        hooks = self.hooks
+        if hooks is None or len(hooks) != len(self.ops):
+            return list(self.ops), None
+        trec = _ttrace.get_recorder() if trace_on else None
+        frec = _flight.get_recorder() if flight_on else None
+        hazard = SlotHazardChecker() if race_on else None
+        meta = self.op_meta
+        if meta is None or len(meta) != len(self.ops):
+            trace_on, meta = False, None
+        wrapped: List[Any] = []
+        for i, (op, hook) in enumerate(zip(self.ops, hooks)):
+            w = op
+            if fault_on and hook.fault_site is not None:
+                w = _wrap_fault(w, hook)
+            if hazard is not None:
+                w = _wrap_hazard(w, hook, hazard)
+            if flight_on:
+                w = _wrap_flight(w, hook, frec)
+            if trace_on:
+                name, cat, track = meta[i]
+                w = _wrap_trace(w, name, cat, track, trec)
+            wrapped.append(w)
+        return wrapped, hazard
 
     def fingerprint(self) -> str:
         import hashlib
@@ -839,6 +1161,11 @@ def lower_to_register_file(
                 "kills": kills,
                 "name": f"RUN {inst.info}",
                 "mesh": inst.dst_mesh,
+                # fault hook point: same site/info/retry semantics the
+                # interpreter uses for this instruction (ISSUE 6)
+                "site": "stage_launch",
+                "finfo": {"stage": inst.info, "mesh_id": inst.dst_mesh},
+                "idem": not donated,
                 "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "
                          f"fix={[(p, str(s)) for p, s, _ in fixups]}"),
@@ -864,6 +1191,10 @@ def lower_to_register_file(
                 "kills": (),
                 "name": f"RESHARD {inst.src_mesh}->{inst.dst_mesh}",
                 "mesh": inst.dst_mesh,
+                "site": "cross_mesh_send",
+                "finfo": {"var": str(v), "src_mesh": inst.src_mesh,
+                          "dst_mesh": inst.dst_mesh},
+                "idem": True,
                 "line": (f"RESHARD {inst.var_key} {inst.src_mesh}->"
                          f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}"),
             })
@@ -890,12 +1221,44 @@ def lower_to_register_file(
         for i, r in enumerate(recs)
     ]
     graph = InstructionDataflowGraph.build(nodes)
+    # static hazard pass on every compile (ISSUE 6): a missing
+    # dependency edge is a lowering bug — fail here, not as silent
+    # numeric corruption three replays later
+    graph.check()
     n_cross = graph.n_cross_mesh
     n = len(recs)
+
+    def _hook_for(r, idx, kind="exec"):
+        reads, writes, kills = r["reads"], r["writes"], r["kills"]
+        site = r.get("site")
+        return OpHook(kind=kind, name=r["name"], node=idx,
+                      mesh=r["mesh"], reads=reads, writes=writes,
+                      kills=kills,
+                      slots=tuple(sorted({*reads, *writes, *kills})),
+                      fault_site=site,
+                      fault_infos=(r["finfo"],) if site else (),
+                      idempotent=r.get("idem", True))
+
+    def _group_hook(mem_idx, kind="exec", label=None):
+        # one hook for a batched same-edge group: union footprint, one
+        # fault info per member (hit counts match the interpreter)
+        mem = [recs[m] for m in mem_idx]
+        first = mem[0]
+        reads = tuple(m["ss"] for m in mem)
+        writes = tuple(m["ds"] for m in mem)
+        name = label or (f"RESHARD-GROUP x{len(mem)} "
+                         f"{first['edge'][0]}->{first['edge'][1]}")
+        return OpHook(kind=kind, name=name, node=mem_idx[0],
+                      mesh=first["mesh"], reads=reads, writes=writes,
+                      slots=tuple(sorted({*reads, *writes})),
+                      fault_site="cross_mesh_send",
+                      fault_infos=tuple(m["finfo"] for m in mem),
+                      idempotent=True)
 
     ops: List[Any] = []
     lines: List[str] = []
     meta: List[Tuple[str, str, str]] = []   # (span name, category, track)
+    hooks: List[OpHook] = []                # per-op hook points (ISSUE 6)
     n_groups = 0
     n_free_hops = 0
     n_hoisted = 0
@@ -912,11 +1275,12 @@ def lower_to_register_file(
                 lines.append(r["line"])
                 meta.append((r["name"], "instruction",
                              f"mesh {r['mesh']}"))
+                hooks.append(_hook_for(r, i))
                 i += 1
                 continue
             edge = r["edge"]
-            members: List[Dict[str, Any]] = []
-            hopped: List[Dict[str, Any]] = []   # FREEs emitted post-group
+            members: List[int] = []             # rec indices in the group
+            hopped: List[int] = []              # FREEs emitted post-group
             blocked: set = set()                # slots freed by hopped FREEs
             counted = 0                         # hopped FREEs with a member
                                                 # appended after them
@@ -929,11 +1293,11 @@ def lower_to_register_file(
                     if len(hopped) > counted:
                         n_free_hops += len(hopped) - counted
                         counted = len(hopped)
-                    members.append(q)
+                    members.append(j)
                     j += 1
                     continue
                 if q["kind"] == "FREE":
-                    hopped.append(q)
+                    hopped.append(j)
                     blocked.update(q["slots"])
                     j += 1
                     continue
@@ -941,28 +1305,33 @@ def lower_to_register_file(
             # trailing FREEs (after the last member) keep their original
             # relative position by being re-emitted after the group
             if len(members) == 1:
-                m = members[0]
+                m = recs[members[0]]
                 ops.append(m["op"])
                 lines.append(m["line"] + " edgegroup=1")
                 meta.append((m["name"], "instruction",
                              f"mesh {m['mesh']}"))
+                hooks.append(_hook_for(m, members[0]))
             else:
                 n_groups += 1
+                mem = [recs[m_] for m_ in members]
                 ops.append(_make_reshard_group_op(
-                    DirectTransferGroup([m["transfer"] for m in members]),
-                    tuple(m["ss"] for m in members),
-                    tuple(m["ds"] for m in members)))
-                for m in members:
-                    lines.append(m["line"] + f" edgegroup={len(members)}")
+                    DirectTransferGroup([m["transfer"] for m in mem]),
+                    tuple(m["ss"] for m in mem),
+                    tuple(m["ds"] for m in mem)))
+                for m in mem:
+                    lines.append(m["line"] + f" edgegroup={len(mem)}")
                 meta.append((
-                    f"RESHARD-GROUP x{len(members)} "
+                    f"RESHARD-GROUP x{len(mem)} "
                     f"{edge[0]}->{edge[1]}", "instruction",
-                    f"mesh {members[0]['mesh']}"))
-            for q in hopped:
+                    f"mesh {mem[0]['mesh']}"))
+                hooks.append(_group_hook(members))
+            for qi in hopped:
+                q = recs[qi]
                 ops.append(q["op"])
                 lines.append(q["line"])
                 meta.append((q["name"], "instruction",
                              f"mesh {q['mesh']}"))
+                hooks.append(_hook_for(q, qi))
             i = j
     else:
         # ---- phase 2b: overlap replay of the dataflow graph ----
@@ -998,6 +1367,7 @@ def lower_to_register_file(
                 lines.append(r["line"])
                 meta.append((r["name"], "instruction",
                              f"mesh {r['mesh']}"))
+                hooks.append(_hook_for(r, idx))
             elif kind == "launch":
                 gid = group_of.get(idx)
                 if gid is None:
@@ -1008,6 +1378,7 @@ def lower_to_register_file(
                     lines.append(f"LAUNCH #{idx} " + r["line"])
                     meta.append((f"LAUNCH {r['name']}", "transfer",
                                  f"mesh {r['mesh']}"))
+                    hooks.append(_hook_for(r, idx, kind="launch"))
                 elif group_members[gid][0] == idx:
                     n_launches += 1
                     n_groups += 1
@@ -1024,6 +1395,10 @@ def lower_to_register_file(
                         f"LAUNCH-GROUP x{len(mem)} "
                         f"{r['edge'][0]}->{r['edge'][1]}", "transfer",
                         f"mesh {r['mesh']}"))
+                    hooks.append(_group_hook(
+                        mem, kind="launch",
+                        label=(f"LAUNCH-GROUP x{len(mem)} "
+                               f"{r['edge'][0]}->{r['edge'][1]}")))
                 # non-leading group members were folded into the group op
             else:  # wait
                 gid = group_of.get(idx)
@@ -1032,6 +1407,10 @@ def lower_to_register_file(
                     lines.append(f"WAIT #{idx} slot {r['ds']}")
                     meta.append((f"WAIT {r['name']}", "transfer",
                                  f"mesh {r['mesh']}"))
+                    hooks.append(dataclasses.replace(
+                        _hook_for(r, idx, kind="wait"),
+                        name=f"WAIT {r['name']}",
+                        fault_site=None, fault_infos=()))
                 elif gid not in waited_groups:
                     waited_groups.add(gid)
                     mem = group_members[gid]
@@ -1040,10 +1419,16 @@ def lower_to_register_file(
                     lines.append(f"WAIT-GROUP #{mem}")
                     meta.append((f"WAIT-GROUP x{len(mem)}", "transfer",
                                  f"mesh {r['mesh']}"))
+                    hooks.append(dataclasses.replace(
+                        _group_hook(mem, kind="wait",
+                                    label=f"WAIT-GROUP x{len(mem)}"),
+                        fault_site=None, fault_infos=()))
                 # later member waits are satisfied by the group wait
         lines.append(f"MODE overlap window={window} hoisted={n_hoisted} "
                      f"launches={n_launches}")
 
+    assert len(hooks) == len(ops) == len(meta), (
+        "lowering emitted misaligned op/meta/hook lists")
     return RegisterFileProgram(num_slots=len(slot_of),
                                ops=ops,
                                n_instructions=n,
@@ -1061,7 +1446,8 @@ def lower_to_register_file(
                                overlap_window=(window if mode == "overlap"
                                                else 0),
                                run_stats=run_stats,
-                               op_meta=meta)
+                               op_meta=meta,
+                               hooks=hooks)
 
 
 def emit_free_instructions(instructions: List[PipelineInstruction],

@@ -19,7 +19,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from alpa_tpu.global_env import global_config
-from alpa_tpu.timer import tracer
+from alpa_tpu.telemetry import trace as _ttrace
 
 logger = logging.getLogger(__name__)
 
@@ -443,12 +443,13 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
         B_eff, inflight_mode = 4096, "inference"
     else:
         B_eff, inflight_mode = num_micro_batches, schedule
-    tracer.log("stage-dp-costs", f"L={L} M={M}")
+    _ttrace.instant("stage-dp-costs", "compile",
+                    {"L": L, "M": M})
     part = stage_dp_solve(costs, sizes, D, B_eff, mem_param,
                           mem_act, mem_budget=mem_budget,
                           inflight_mode=inflight_mode)
-    tracer.log("stage-dp-solved",
-               f"stages={len(part) if part else 0}")
+    _ttrace.instant("stage-dp-solved", "compile",
+                    {"stages": len(part) if part else 0})
     if part is None:
         raise RuntimeError(
             "auto stage construction found no feasible partition")

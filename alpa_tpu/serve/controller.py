@@ -564,14 +564,21 @@ class _Handler(BaseHTTPRequestHandler):
         """Liveness wired to the recovery state machine: 200 while
         HEALTHY/SUSPECT/RECOVERING (body carries the state), 503 once
         DEGRADED.  Falls back to the controller health report when no
-        RecoveryManager is attached."""
+        RecoveryManager is attached.  The body also carries
+        ``last_flight_dump`` — the path of the most recent flight
+        recorder post-mortem (ISSUE 6), so an operator seeing a SUSPECT
+        or DEGRADED state knows where the instruction timeline landed
+        (null when nothing has been dumped)."""
+        from alpa_tpu.telemetry import flight as _flight
         recovery = self.controller._recovery
         if recovery is not None:
             state = recovery.state.value
             code = 503 if state == "degraded" else 200
-            self._send(code, {"status": state})
+            self._send(code, {"status": state,
+                              "last_flight_dump": _flight.last_dump_path()})
             return
         report = self.controller.health_report()
+        report["last_flight_dump"] = _flight.last_dump_path()
         code = 503 if report["status"] == "shedding" else 200
         self._send(code, report)
 

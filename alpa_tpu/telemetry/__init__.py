@@ -7,6 +7,9 @@ Two pillars:
 * :mod:`alpa_tpu.telemetry.metrics` — central Counter/Gauge/Histogram
   registry with Prometheus text exposition; every ad-hoc stat in the
   repo is a view over it.
+* :mod:`alpa_tpu.telemetry.flight` — always-on flight recorder (ISSUE
+  6): fixed-size lock-free ring of the last N instruction events,
+  auto-dumped on step failure / fault fire / SUSPECT transition.
 
 See docs/observability.md for the span model, category taxonomy and
 knob table (``ALPA_TPU_TRACE`` / ``ALPA_TPU_TRACE_DIR`` /
@@ -19,11 +22,12 @@ from alpa_tpu.telemetry.trace import (         # noqa: F401
     CATEGORIES, TraceRecorder, begin, counter, enabled, end,
     get_recorder, instant, merge_chrome_traces, set_enabled,
     set_recorder, span)
+from alpa_tpu.telemetry.flight import FlightRecorder  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DEFAULT_BUCKETS", "get_registry", "reset_registry",
     "CATEGORIES", "TraceRecorder", "begin", "counter", "enabled",
     "end", "get_recorder", "instant", "merge_chrome_traces",
-    "set_enabled", "set_recorder", "span",
+    "set_enabled", "set_recorder", "span", "FlightRecorder",
 ]

@@ -28,11 +28,19 @@ same register-mode payload replayed with span tracing off vs on,
 recording the per-instruction overhead tracing adds (the
 zero-cost-when-off guard asserted by tests/runtime/test_telemetry.py).
 
+A fourth section measures the hook-instrumented graph executor
+(ISSUE 6): the register-mode payload with every per-node hook class
+compiled in — span tracing on, fault sites armed (a FaultPlan whose
+specs never fire), flight recorder on — vs the same payload with all
+hooks off.  The hooked per-instruction number is what production
+debugging costs; tests/runtime/test_unified_executor.py pins it at
+< 2x the unhooked register replay.
+
 Writes ``benchmark/results/dispatch_modes.json`` with per-mode
 per-instruction latency, the speedup of the register path over both
 live interpreter runs and the committed 160.8 us/inst artifact
 baseline, the reshard-heavy wall-clock comparison, and the telemetry
-overhead section.
+and hooked-executor overhead sections.
 
 Usage::
 
@@ -49,10 +57,13 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-# per_inst_us of the committed threaded-mode artifact
-# (benchmark/results/dispatch_overhead.json); the ISSUE 2 acceptance bar
-# is >= 5x reduction vs this number.
-ARTIFACT_BASELINE_US = 160.8
+# HISTORICAL: per_inst_us of the committed threaded-mode artifact
+# (benchmark/results/dispatch_overhead.json), kept only as the fixed
+# denominator of the ISSUE 2 acceptance bar (>= 5x reduction).  Threaded
+# mode is a legacy interpreter path — `auto` never selects it — so this
+# number must not grow new uses; compare against the live interpreter
+# rows instead.
+THREADED_ARTIFACT_US_HISTORICAL = 160.8
 
 MODES = ("sequential", "threaded", "registers", "overlap")
 
@@ -109,12 +120,12 @@ def run_modes(n_steps: int = 8):
                    "is driver dispatch, not compute)",
         "n_instructions": results["registers"]["n_instructions"],
         "modes": results,
-        "artifact_baseline_us": ARTIFACT_BASELINE_US,
+        "artifact_baseline_us": THREADED_ARTIFACT_US_HISTORICAL,
         "speedup_vs_sequential":
             results["sequential"]["per_inst_us"] / reg,
         "speedup_vs_threaded":
             results["threaded"]["per_inst_us"] / reg,
-        "speedup_vs_artifact": ARTIFACT_BASELINE_US / reg,
+        "speedup_vs_artifact": THREADED_ARTIFACT_US_HISTORICAL / reg,
     }
 
 
@@ -259,6 +270,84 @@ def run_telemetry_overhead(n_steps: int = 8,
     }
 
 
+def run_hooked(n_steps: int = 8):
+    """Register-mode per-instruction latency with all per-node hooks
+    compiled in vs all hooks off (ISSUE 6).  Hooks-on arms every hook
+    class the graph executor supports: span tracing, fault-injection
+    sites (an installed FaultPlan whose spec can never fire, so only
+    the instrumentation cost is measured), and the flight recorder."""
+    import alpa_tpu
+    from alpa_tpu import PipeshardParallel
+    from alpa_tpu import fault
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.telemetry import trace as ttrace
+    from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    alpa_tpu.init(cluster="local")
+    prev_mode = global_config.pipeline_dispatch_mode
+    prev_flight = global_config.flight_recorder
+    global_config.pipeline_dispatch_mode = "registers"
+    method = PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=AutoLayerOption(layer_num=8),
+        stage_option=UniformStageOption(num_stages=8))
+    step = get_mlp_train_step(method, use_value_and_grad=True)
+    state, batch = create_mlp_train_state_and_batch(
+        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+        num_layers=8)
+    state, loss = step(state, batch)   # compile + lower
+    float(loss)
+    ex = step.get_last_executable()
+
+    def best_stats(state):
+        best = None
+        for _ in range(n_steps):
+            state, loss = step(state, batch)
+            float(loss)
+            st = dict(ex.last_dispatch_stats)
+            if best is None or st["per_inst_us"] < best["per_inst_us"]:
+                best = st
+        return best, state
+
+    try:
+        # hooks off: flight disabled too, so the replay takes the raw
+        # closure loop (the ISSUE 5 <2% disabled-overhead path)
+        global_config.flight_recorder = False
+        off, state = best_stats(state)
+        assert not off.get("hooks"), off
+
+        # hooks on: trace + armed-not-firing fault plan + flight
+        global_config.flight_recorder = True
+        prev_enabled = ttrace.set_enabled(True)
+        armed = fault.FaultPlan(
+            fault.FaultSpec("stage_launch", kind="error", after=10**9))
+        try:
+            ttrace.get_recorder().clear()
+            with armed:
+                on, state = best_stats(state)
+        finally:
+            ttrace.set_enabled(prev_enabled)
+        for h in ("trace", "fault", "flight"):
+            assert h in on.get("hooks", ()), on
+    finally:
+        global_config.pipeline_dispatch_mode = prev_mode
+        global_config.flight_recorder = prev_flight
+
+    return {
+        "payload": "registers mode, same dispatch payload as 'modes'",
+        "hooks_on": list(on["hooks"]),
+        "hooks_off_per_inst_us": off["per_inst_us"],
+        "hooks_on_per_inst_us": on["per_inst_us"],
+        "hooked_overhead_fraction":
+            on["per_inst_us"] / off["per_inst_us"] - 1.0,
+        "fault_hits_while_armed": armed.hits("stage_launch"),
+    }
+
+
 def main():
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--steps", type=int, default=8,
@@ -281,6 +370,7 @@ def main():
     report["reshard_heavy"] = run_reshard_heavy(args.steps)
     report["telemetry"] = run_telemetry_overhead(args.steps,
                                                  trace_out=trace_out)
+    report["hooked"] = run_hooked(args.steps)
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)

@@ -6,6 +6,7 @@ Usage::
     python scripts/trace_tool.py merge   OUT.json TRACE.json [TRACE.json...]
     python scripts/trace_tool.py summarize TRACE.json [--top N]
     python scripts/trace_tool.py top     TRACE.json [--top N]
+    python scripts/trace_tool.py flight  FLIGHT.json [--last N]
 
 ``record`` runs CMD as a child process with ``ALPA_TPU_TRACE=1`` and
 ``ALPA_TPU_TRACE_DIR`` pointed at a scratch dir, then merges whatever
@@ -15,6 +16,12 @@ track group in Perfetto); ``summarize`` prints total time per category
 plus the longest individual spans; ``top`` aggregates spans by name
 (hottest instructions first).  All outputs load directly in
 https://ui.perfetto.dev.
+
+``flight`` pretty-prints a flight-recorder dump (ISSUE 6): the ring of
+last-N instruction events the runtime auto-saves when a step raises, a
+fault site fires, or the watchdog declares a mesh SUSPECT.  Dumps come
+from ``dump_debug_info`` (``flight.json``) or the auto-dump path logged
+at WARNING level (``alpa_flight_<pid>_<seq>.json``).
 """
 import argparse
 import collections
@@ -126,6 +133,52 @@ def cmd_top(args):
         print(f"{us / 1e3:>12.3f} {n:>7} {us / n / 1e3:>10.3f}  {name}")
 
 
+def cmd_flight(args):
+    from alpa_tpu.telemetry.flight import load_dump  # noqa: E402
+    try:
+        dump = load_dump(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        sys.exit(f"{args.dump}: {e}")
+    events = dump["events"]
+    print(f"flight dump: {args.dump}")
+    print(f"  reason:    {dump.get('reason') or '(manual)'}")
+    print(f"  capacity:  {dump.get('capacity')}  "
+          f"events: {len(events)}  "
+          f"seq: {dump.get('first_seq')}..{dump.get('last_seq')}")
+    if not events:
+        print("  (empty ring)")
+        return
+    show = events[-args.last:] if args.last else events
+    if len(show) < len(events):
+        print(f"  showing last {len(show)} of {len(events)}")
+    t_end = max(e["t_end_us"] for e in events)
+    print(f"\n{'seq':>6} {'t-end':>9} {'dur ms':>9} {'mesh':>4} "
+          f"{'node':>5} {'kind':<7} {'outcome':<10} name")
+    for e in show:
+        dur_ms = (e["t_end_us"] - e["t_start_us"]) / 1e3
+        rel_ms = (e["t_end_us"] - t_end) / 1e3
+        slots = ""
+        if e.get("slots"):
+            s = ",".join(str(x) for x in e["slots"][:4])
+            more = len(e["slots"]) - 4
+            slots = f"  [slots {s}{f',+{more}' if more > 0 else ''}]"
+        print(f"{e['seq']:>6} {rel_ms:>8.1f}m {dur_ms:>9.3f} "
+              f"{e['mesh'] if e['mesh'] is not None else '-':>4} "
+              f"{e['node'] if e['node'] is not None else '-':>5} "
+              f"{e['kind']:<7} {e['outcome']:<10} {e['name']}{slots}")
+    bad = [e for e in events if e["outcome"] != "ok"]
+    if bad:
+        print(f"\n{len(bad)} non-ok event(s):")
+        per = collections.Counter(e["outcome"] for e in bad)
+        for outcome, n in per.most_common():
+            print(f"  {outcome:<24} x{n}")
+        last = bad[-1]
+        print(f"  last: seq {last['seq']} {last['kind']} "
+              f"{last['name']} -> {last['outcome']}")
+    else:
+        print("\nall events ok")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -149,6 +202,13 @@ def main(argv=None):
     pt.add_argument("trace")
     pt.add_argument("--top", type=int, default=20)
     pt.set_defaults(func=cmd_top)
+
+    pf = sub.add_parser("flight",
+                        help="pretty-print a flight-recorder dump")
+    pf.add_argument("dump")
+    pf.add_argument("--last", type=int, default=0,
+                    help="show only the last N events (0 = all)")
+    pf.set_defaults(func=cmd_flight)
 
     args = p.parse_args(argv)
     args.func(args)

@@ -99,17 +99,19 @@ def test_overlap_stats_shape():
     assert ovl.graph.preds == reg.graph.preds
 
 
-def test_overlap_falls_back_when_tracing():
-    """Trace collection needs the interpreter's per-instruction hooks, so
-    overlap (like registers) must fall back — the PR 1 instrumentation
-    gate extends to the new mode."""
+def test_overlap_stays_on_graph_executor_when_tracing():
+    """Trace collection no longer forces the interpreter: spans are
+    compiled into the replay plan as per-node hooks (ISSUE 6), so
+    overlap keeps running on the graph executor with the trace hook
+    reported in its dispatch stats."""
     alpa_tpu.init("local")
     prev = global_config.collect_trace
     global_config.collect_trace = True
     try:
         _, _, ex = _run_steps("overlap", n_steps=1)
-        assert ex.last_dispatch_stats["mode"] not in ("overlap",
-                                                      "registers")
+        st = ex.last_dispatch_stats
+        assert st["mode"] == "overlap"
+        assert "trace" in st["hooks"]
     finally:
         global_config.collect_trace = prev
 
