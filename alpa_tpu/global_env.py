@@ -134,6 +134,39 @@ class GlobalConfig:
         # measure how much of that idle time each mode hides.  0 = off.
         self.resharding_transfer_latency_s = float(os.environ.get(
             "ALPA_TPU_TRANSFER_LATENCY", "0"))
+        # How resharding_transfer_latency_s is charged (ISSUE 7):
+        # "call" (legacy) idles once per transfer call regardless of the
+        # transfer's link structure; "link" idles latency x the busiest
+        # link's message count (plus bytes/bandwidth when
+        # resharding_wire_bandwidth is set), so collective strategies
+        # that cut per-link messages show their wall-clock win under
+        # emulation.  The strategy cost model mirrors whichever model is
+        # active, keeping auto selection honest about what it is timed
+        # against.
+        self.resharding_wire_model = os.environ.get(
+            "ALPA_TPU_WIRE_MODEL", "call")
+        # Emulated per-link wire bandwidth in bytes/s for the "link"
+        # model; 0 = latency-only emulation.
+        self.resharding_wire_bandwidth = float(os.environ.get(
+            "ALPA_TPU_WIRE_BANDWIDTH", "0"))
+        # Cross-mesh RESHARD lowering strategy (ISSUE 7): "auto" picks
+        # per edge by the collective cost model (wire-emulation cross
+        # leg + mesh_profiling intra-mesh collective leg); forcing
+        # "direct_p2p" | "slice_all_gather" | "all_to_all" |
+        # "reduce_scatter_gather" pins every edge where the strategy is
+        # eligible (ineligible edges fall back to direct_p2p).
+        self.reshard_strategy = os.environ.get(
+            "ALPA_TPU_RESHARD_STRATEGY", "auto")
+        # Lossy transfer codec for cross-mesh ACTIVATION edges (ISSUE 7):
+        # "off" | "int8" | "fp8".  Opt-in; applies only to fp32/bf16
+        # payloads at least reshard_quantize_min_bytes large, and never
+        # to microbatch-invariant values (weights, consts, grad
+        # accumulators).  Error bounds: pipeline_parallel/reshard_codec.
+        self.reshard_quantize = os.environ.get(
+            "ALPA_TPU_RESHARD_QUANTIZE", "off")
+        # Minimum payload bytes before the transfer codec applies.
+        self.reshard_quantize_min_bytes = int(os.environ.get(
+            "ALPA_TPU_RESHARD_QUANTIZE_MIN_BYTES", "65536"))
 
         # ---------- compile cache ----------
         # On-disk tier of the persistent compile cache (ILP auto-sharding

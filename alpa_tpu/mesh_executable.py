@@ -17,6 +17,7 @@ Key translations (SURVEY.md §2.5):
   inside the compiled program; a helper remains for the pipeline runtime.
 """
 import logging
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
@@ -25,10 +26,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from alpa_tpu.device_mesh import PhysicalDeviceMesh
-from alpa_tpu.timer import timers
+from alpa_tpu.telemetry import metrics as _tmetrics
 from alpa_tpu.util import benchmark_func
 
 logger = logging.getLogger(__name__)
+
+# dispatch (enqueue) latency of single-mesh executables — replaces the
+# deprecated per-executable timers(f"exec-{uuid}-dispatch") bridge
+_DISPATCH_SECONDS = _tmetrics.get_registry().histogram(
+    "alpa_mesh_dispatch_seconds",
+    "launch_on_driver enqueue latency per mesh executable call")
 
 mesh_executable_counter = 0
 
@@ -104,17 +111,17 @@ class NormalMeshExecutable(MeshExecutable):
     def launch_on_driver(self, *flat_args):
         """Execute on flat (already tree-flattened) args.
 
-        Dispatch is async (jax futures); the ``exec-N-dispatch`` timer
-        measures enqueue latency only.  Use ``profile_with_dummy_inputs``
-        or block on the outputs for wall-clock execution time.
+        Dispatch is async (jax futures); the
+        ``alpa_mesh_dispatch_seconds`` histogram measures enqueue
+        latency only.  Use ``profile_with_dummy_inputs`` or block on the
+        outputs for wall-clock execution time.
         """
-        timer = timers(self.timer_name + "-dispatch")
-        timer.start()
+        t0 = time.perf_counter()
         try:
             args = self._prepare_args(flat_args)
             return self.compiled(*args)
         finally:
-            timer.stop()
+            _DISPATCH_SECONDS.observe(time.perf_counter() - t0)
 
     def _prepare_args(self, flat_args):
         """Commit plain host arrays to the mesh per the input shardings.

@@ -177,6 +177,11 @@ def dump_debug_info(executable, dump_dir: str):
         write("instructions.txt", executable.get_instruction_text())
     if hasattr(executable, "get_resharding_report"):
         write("resharding.txt", executable.get_resharding_report())
+    # per-edge collective strategy decisions (ISSUE 7); also printable
+    # standalone via `scripts/reshard_tool.py plan`
+    from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+        format_resharding_plan)
+    write("resharding_plan.txt", format_resharding_plan())
     write("compile_cache.txt", format_compile_cache_report())
     write("checkpoint.txt", format_checkpoint_report())
     write("overlap.txt", format_overlap_report())

@@ -166,6 +166,25 @@ class ReshardingTaskSpec:
     send_order: Tuple[Tuple[int, int], ...] = ()
     # whether load-balanced source selection / routing was applied
     loadbalanced: bool = True
+    # ---- collective lowering (ISSUE 7) ----
+    # chosen per-edge strategy for the executor path (one of
+    # RESHARD_STRATEGIES); generalizes the allgather_rewrite boolean —
+    # the tile ``requests`` above stay the interpreter/tiled-mode source
+    # of truth, this field only drives the register/overlap executors
+    strategy: str = "direct_p2p"
+    # per-candidate cost-model estimates in seconds (reports / tooling)
+    strategy_costs: Dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    # per-candidate cross-mesh link stats: candidate -> dict with
+    # max_link_messages / max_link_bytes / total_bytes of the wire leg
+    strategy_stats: Dict[str, Dict[str, float]] = dataclasses.field(
+        default_factory=dict)
+    # the CHOSEN strategy's busiest-link message count and total wire
+    # bytes (feeds the "link" wire-emulation model and reports)
+    wire_messages: int = 1
+    wire_bytes: float = 0.0
+    # whether the strategy decision came from the compile cache
+    strategy_cached: bool = False
 
     def total_tiles(self):
         return sum(len(r.srcs) for r in self.requests)
@@ -482,6 +501,39 @@ def plan_resharding(shape: Tuple[int, ...],
     else:
         spec.max_link_bytes_naive = spec.max_link_bytes
         spec.max_link_bytes_broadcast_naive = spec.max_link_bytes_broadcast
+    # collective lowering (ISSUE 7): pick the per-edge strategy by the
+    # cost model (cache-backed so warm restarts replay identically) and
+    # record the decision for dump_debug_info / reshard_tool
+    try:
+        strat, costs, cached = resolve_strategy(shape, itemsize,
+                                                src_sharding, dst_sharding)
+        opts = collective_options(shape, itemsize, src_sharding,
+                                  dst_sharding)
+        spec.strategy = strat if strat in opts else "direct_p2p"
+        spec.strategy_costs = costs
+        spec.strategy_stats = {k: dict(o["stats"])
+                               for k, o in opts.items()}
+        st = opts[spec.strategy]["stats"]
+        spec.wire_messages = int(st["max_link_messages"])
+        spec.wire_bytes = float(st["total_bytes"])
+        spec.strategy_cached = bool(cached)
+        _STRATEGY_COUNT.labels(spec.strategy).inc()
+        _RECENT_PLANS.append({
+            "shape": tuple(shape),
+            "itemsize": int(itemsize),
+            "src": _sharding_key(src_sharding),
+            "dst": _sharding_key(dst_sharding),
+            "strategy": spec.strategy,
+            "costs": dict(costs),
+            "cached": bool(cached),
+            "wire_messages": spec.wire_messages,
+            "wire_bytes": spec.wire_bytes,
+            "transfer_bytes": spec.transfer_bytes,
+            "max_link_bytes": spec.max_link_bytes,
+        })
+    except Exception:  # pylint: disable=broad-except
+        logger.warning("collective strategy planning failed; "
+                       "keeping direct_p2p", exc_info=True)
     _record_plan(spec)
     _ttrace.end(tok)
     return spec
@@ -508,6 +560,322 @@ def naive_transfer_bytes(shape, itemsize, dst_sharding,
 
 
 ########################################
+# collective strategy planning (ISSUE 7)
+########################################
+
+# Per-edge lowering strategies, generalizing the allgather_rewrite
+# boolean ("Memory-efficient array redistribution through portable
+# collective communication", PAPERS.md):
+#
+# * ``direct_p2p`` — today's path: one cross-mesh device_put straight to
+#   the destination sharding.
+# * ``slice_all_gather`` — destination replicates over some mesh axis:
+#   each destination device receives only a disjoint 1/k slice
+#   cross-mesh and the destination mesh all-gathers over its own links.
+# * ``all_to_all`` — destination is a permuted/transposed layout of the
+#   source: land source-shaped shards 1:1 (one message per link), then
+#   re-lay inside the destination mesh with an all-to-all.
+# * ``reduce_scatter_gather`` — source is replicated / partial-
+#   reducible: pull disjoint scattered pieces from distinct source
+#   replicas, then gather inside the destination mesh.
+RESHARD_STRATEGIES = ("direct_p2p", "slice_all_gather", "all_to_all",
+                      "reduce_scatter_gather")
+
+# intra-destination-mesh collective each strategy's second leg emits,
+# charged from mesh_profiling's per-kind (alpha, beta) calibration
+_STRATEGY_COLLECTIVE_KIND = {
+    "direct_p2p": None,
+    "slice_all_gather": "all_gather",
+    "all_to_all": "all_to_all",
+    "reduce_scatter_gather": "reduce_scatter",
+}
+
+
+def _sharding_key(sharding) -> str:
+    """Device-id-free canonical form of a NamedSharding (cache keys and
+    reports): mesh axis sizes + partition spec."""
+    try:
+        return f"{dict(sharding.mesh.shape)}|{sharding.spec}"
+    except Exception:  # pylint: disable=broad-except
+        return str(sharding)
+
+
+def _spec_entries(sharding, ndim) -> Optional[Tuple]:
+    """PartitionSpec as a length-``ndim`` tuple of None | axis name.
+    None (whole) when the spec uses tuple entries — the conservative
+    strategies below skip those edges."""
+    try:
+        entries = tuple(sharding.spec)
+    except Exception:  # pylint: disable=broad-except
+        return None
+    entries = entries + (None,) * (ndim - len(entries))
+    if any(isinstance(e, (tuple, list)) for e in entries):
+        return None
+    return entries
+
+
+def _mesh_axis_sizes(sharding) -> Dict[str, int]:
+    return dict(sharding.mesh.shape)
+
+
+def _replication(sharding, shape) -> int:
+    vda = VirtualDistributedArray.from_sharding(shape, sharding)
+    uniq = vda.unique_tiles
+    return max(len(v) for v in uniq.values()) if uniq else 1
+
+
+def _scatter_sharding(dst_sharding, shape):
+    """The 1/k "slice" landing layout: the destination spec with unused
+    destination-mesh axes attached to the largest still-whole dims they
+    divide.  The gather leg restores the true destination layout."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    entries = _spec_entries(dst_sharding, len(shape))
+    if entries is None:
+        return None
+    entries = list(entries)
+    sizes = _mesh_axis_sizes(dst_sharding)
+    used = {e for e in entries if e is not None}
+    changed = False
+    for ax, k in sizes.items():
+        if ax in used or k <= 1:
+            continue
+        cands = [(shape[d], d) for d, e in enumerate(entries)
+                 if e is None and shape[d] % k == 0 and shape[d] >= k]
+        if not cands:
+            continue
+        # largest dim, lowest index on ties: aligns the scatter with the
+        # leading-dim shardings sources usually carry (fewer wire msgs)
+        best = max(sz for sz, _ in cands)
+        entries[min(d for sz, d in cands if sz == best)] = ax
+        changed = True
+    if not changed:
+        return None
+    return NamedSharding(dst_sharding.mesh, PartitionSpec(*entries))
+
+
+def _translate_spec(src_sharding, dst_sharding, shape):
+    """The source layout re-expressed on the destination mesh (the
+    all-to-all landing layout), or None when the meshes' axis structures
+    do not line up (conservative: same-named equal-size axes only)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    entries = _spec_entries(src_sharding, len(shape))
+    if entries is None:
+        return None
+    src_sizes = _mesh_axis_sizes(src_sharding)
+    dst_sizes = _mesh_axis_sizes(dst_sharding)
+    for e in entries:
+        if e is not None and dst_sizes.get(e) != src_sizes.get(e):
+            return None
+    return NamedSharding(dst_sharding.mesh, PartitionSpec(*entries))
+
+
+def _strategy_link_stats(shape, itemsize, src_sharding,
+                         landing_sharding) -> Dict[str, float]:
+    """Cross-mesh wire-leg stats when each landing-layout shard pulls its
+    tile from (load-balanced) source holders: busiest-link message count,
+    busiest-link bytes, and total bytes crossing."""
+    src_vda = VirtualDistributedArray.from_sharding(shape, src_sharding)
+    land_vda = VirtualDistributedArray.from_sharding(shape,
+                                                     landing_sharding)
+    load: Dict[int, float] = {}
+    eg_m: Dict[int, int] = {}
+    in_m: Dict[int, int] = {}
+    eg_b: Dict[int, float] = {}
+    in_b: Dict[int, float] = {}
+    total = 0.0
+    for i, dtile in enumerate(land_vda.device_tiles):
+        ddev = land_vda.device_ids[i]
+        for ts in _cover_tile(dtile, src_vda, load, itemsize, True):
+            b = ts.tile.size * itemsize
+            sdev = src_vda.device_ids[ts.src_shard_index]
+            eg_m[sdev] = eg_m.get(sdev, 0) + 1
+            in_m[ddev] = in_m.get(ddev, 0) + 1
+            eg_b[sdev] = eg_b.get(sdev, 0.0) + b
+            in_b[ddev] = in_b.get(ddev, 0.0) + b
+            total += b
+    msgs = list(eg_m.values()) + list(in_m.values())
+    byts = list(eg_b.values()) + list(in_b.values())
+    return {
+        "max_link_messages": int(max(msgs)) if msgs else 0,
+        "max_link_bytes": float(max(byts)) if byts else 0.0,
+        "total_bytes": float(total),
+    }
+
+
+def collective_options(shape, itemsize, src_sharding, dst_sharding
+                       ) -> Dict[str, Dict[str, Any]]:
+    """Eligible strategies for one edge, in preference (tie-break)
+    order: name -> {"landing": sharding the wire leg targets, "kind":
+    intra-mesh collective kind (None for direct), "stats": wire-leg link
+    stats}.  ``direct_p2p`` is always present."""
+    opts: Dict[str, Dict[str, Any]] = {}
+
+    def add(name, landing):
+        opts[name] = {
+            "landing": landing,
+            "kind": _STRATEGY_COLLECTIVE_KIND[name],
+            "stats": _strategy_link_stats(shape, itemsize, src_sharding,
+                                          landing),
+        }
+
+    add("direct_p2p", dst_sharding)
+    try:
+        src_repl = _replication(src_sharding, shape)
+        dst_repl = _replication(dst_sharding, shape)
+    except Exception:  # pylint: disable=broad-except
+        return opts
+    dst_entries = _spec_entries(dst_sharding, len(shape))
+    scattered = _scatter_sharding(dst_sharding, shape) \
+        if dst_entries is not None else None
+    if src_repl > 1 and scattered is not None:
+        # distinct source replicas serve disjoint scattered pieces
+        add("reduce_scatter_gather", scattered)
+    if dst_repl > 1 and scattered is not None:
+        add("slice_all_gather", scattered)
+    if src_repl == 1 and dst_repl == 1:
+        translated = _translate_spec(src_sharding, dst_sharding, shape)
+        if (translated is not None and dst_entries is not None and
+                _spec_entries(translated, len(shape)) != dst_entries):
+            add("all_to_all", translated)
+    return opts
+
+
+def _strategy_cost(stats: Dict[str, float], kind: Optional[str],
+                   nbytes: float, cal, lat: float, bw: float,
+                   model: str) -> float:
+    """Estimated edge seconds = cross-mesh wire leg (mirroring the
+    active emulation model, so auto selection is honest about what it is
+    timed against) + intra-destination collective leg from
+    mesh_profiling's calibrated (alpha, beta) cost dicts."""
+    if model == "link":
+        cross = lat * stats["max_link_messages"]
+    else:                       # "call": one idle per transfer call
+        cross = lat
+    if bw:
+        cross += stats["max_link_bytes"] / bw
+    intra = 0.0
+    if kind is not None and cal is not None:
+        ab = cal.alpha_beta(kind)
+        if ab is not None:
+            intra = ab[0] + ab[1] * nbytes
+    return cross + intra
+
+
+def choose_strategy(shape, itemsize, src_sharding, dst_sharding
+                    ) -> Tuple[str, Dict[str, float],
+                               Dict[str, Dict[str, Any]]]:
+    """Pick the cheapest eligible strategy for one cross-mesh edge
+    (``global_config.reshard_strategy`` forces a specific one when not
+    "auto"; ineligible forced strategies fall back to direct_p2p).
+    Returns (strategy, per-candidate costs, candidate options)."""
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.mesh_profiling import get_effective_calibration
+    opts = collective_options(shape, itemsize, src_sharding, dst_sharding)
+    try:
+        cal = get_effective_calibration()
+    except Exception:  # pylint: disable=broad-except
+        cal = None
+    lat = global_config.resharding_transfer_latency_s
+    bw = getattr(global_config, "resharding_wire_bandwidth", 0.0)
+    model = getattr(global_config, "resharding_wire_model", "call")
+    nbytes = float(np.prod(shape, dtype=np.int64)) * itemsize \
+        if shape else float(itemsize)
+    costs = {name: _strategy_cost(o["stats"], o["kind"], nbytes, cal,
+                                  lat, bw, model)
+             for name, o in opts.items()}
+    forced = getattr(global_config, "reshard_strategy", "auto")
+    if forced != "auto":
+        chosen = forced if forced in opts else "direct_p2p"
+    else:
+        order = list(opts)
+        chosen = min(order, key=lambda n: (costs[n], order.index(n)))
+    return chosen, costs, opts
+
+
+def resolve_strategy(shape, itemsize, src_sharding, dst_sharding
+                     ) -> Tuple[str, Dict[str, float], bool]:
+    """Cache-backed :func:`choose_strategy`: per-edge decisions persist
+    in the compile cache (namespace ``reshard_strategy``), so a warm
+    restart replays the identical plan without re-costing.  The key
+    covers the edge signature AND every knob the cost model reads.
+    Returns (strategy, costs, from_cache)."""
+    from alpa_tpu.compile_cache import cache_enabled, get_compile_cache
+    from alpa_tpu.global_env import global_config
+    parts = (tuple(shape), int(itemsize),
+             _sharding_key(src_sharding), _sharding_key(dst_sharding),
+             getattr(global_config, "reshard_strategy", "auto"),
+             getattr(global_config, "resharding_wire_model", "call"),
+             global_config.resharding_transfer_latency_s,
+             getattr(global_config, "resharding_wire_bandwidth", 0.0))
+    cache = get_compile_cache() if cache_enabled() else None
+    key = cache.make_key("reshard_strategy", parts) if cache else None
+    if cache is not None:
+        hit = cache.get("reshard_strategy", key)
+        if isinstance(hit, dict) and hit.get("strategy") in \
+                RESHARD_STRATEGIES:
+            return hit["strategy"], dict(hit.get("costs", {})), True
+    chosen, costs, _opts = choose_strategy(shape, itemsize, src_sharding,
+                                           dst_sharding)
+    if cache is not None:
+        cache.put("reshard_strategy", key,
+                  {"strategy": chosen, "costs": costs})
+    return chosen, costs, False
+
+
+# last-N per-edge strategy decisions, for dump_debug_info's
+# resharding_plan.txt and scripts/reshard_tool.py
+from collections import deque as _deque  # noqa: E402
+
+_RECENT_PLANS: "_deque" = _deque(maxlen=128)
+
+_STRATEGY_COUNT = _PLANNER_REG.counter(
+    "alpa_reshard_strategy_total",
+    "Cross-mesh resharding edges planned, per chosen strategy",
+    labelnames=("kind",))
+
+
+def strategy_plan_fingerprint() -> str:
+    """Content hash over the recorded per-edge strategy decisions (in
+    recording order): two runs that planned the same edges to the same
+    strategies fingerprint identically — the warm-restart replay check
+    in benchmark/resharding_bench.py."""
+    import hashlib
+    h = hashlib.sha256()
+    for p in _RECENT_PLANS:
+        h.update(f"{p['shape']}|{p['itemsize']}|{p['src']}|{p['dst']}|"
+                 f"{p['strategy']}".encode())
+    return h.hexdigest()
+
+
+def reset_recent_plans():
+    _RECENT_PLANS.clear()
+
+
+def format_resharding_plan() -> str:
+    """Human-readable per-edge strategy report (dump_debug_info's
+    resharding_plan.txt; scripts/reshard_tool.py)."""
+    if not _RECENT_PLANS:
+        return "resharding plan: (no cross-mesh edges planned yet)"
+    lines = [f"resharding plan ({len(_RECENT_PLANS)} most recent edges; "
+             "strategy chosen by the collective cost model):"]
+    for p in _RECENT_PLANS:
+        costs = " ".join(f"{k}={v * 1e3:.3f}ms"
+                         for k, v in sorted(p["costs"].items()))
+        lines.append(
+            f"  {p['shape']} x{p['itemsize']}B {p['src']} -> {p['dst']}")
+        lines.append(
+            f"    strategy={p['strategy']}"
+            f"{' (cached)' if p['cached'] else ''} "
+            f"wire_msgs={p['wire_messages']} "
+            f"wire_bytes={p['wire_bytes']:.0f} "
+            f"planned_bytes={p['transfer_bytes']:.0f} "
+            f"max_link={p['max_link_bytes']:.0f}")
+        if costs:
+            lines.append(f"    est: {costs}")
+    return "\n".join(lines)
+
+
+########################################
 # execution
 ########################################
 
@@ -529,7 +897,7 @@ def shard_structures_match(shape, src_sharding, dst_sharding) -> bool:
     return list(src_map.values()) == list(dst_map.values())
 
 
-def _apply_sync_semantics(out):
+def _apply_sync_semantics(out, wire=None):
     """Blocking-transfer emulation (ISSUE 4 benchmark support).
 
     The CPU test backend's shard moves are asynchronous in-process
@@ -540,16 +908,36 @@ def _apply_sync_semantics(out):
     ``resharding_transfer_latency_s`` it additionally idles for the
     emulated wire time.  Both default off and cost one attribute read
     per transfer call.
+
+    ``wire``, when given, is the transfer's ``(max_link_messages,
+    max_link_bytes)`` from the planner's link stats.  Under
+    ``resharding_wire_model == "link"`` the idle time scales with the
+    busiest link — ``latency × messages + bytes / bandwidth`` — so a
+    strategy that sends fewer, bigger messages per link actually runs
+    faster under emulation, matching what the cost model charges it.
+    The default ``"call"`` model keeps the legacy one-idle-per-call
+    semantics regardless of ``wire``.
     """
     from alpa_tpu.global_env import global_config
     lat = global_config.resharding_transfer_latency_s
-    if lat or global_config.sync_resharding_transfers:
+    bw = getattr(global_config, "resharding_wire_bandwidth", 0.0)
+    if lat or bw or global_config.sync_resharding_transfers:
         import time as _time
 
         import jax
         jax.block_until_ready(out)
-        if lat:
-            _time.sleep(lat)
+        idle = 0.0
+        if (wire is not None and
+                getattr(global_config, "resharding_wire_model",
+                        "call") == "link"):
+            msgs, link_bytes = wire
+            idle = lat * max(1, int(msgs))
+            if bw:
+                idle += link_bytes / bw
+        elif lat:
+            idle = lat
+        if idle:
+            _time.sleep(idle)
 
 
 class DirectTransfer:
@@ -572,11 +960,14 @@ class DirectTransfer:
     """
 
     __slots__ = ("dst_sharding", "src_sharding", "ndim", "fast",
-                 "nbytes", "_dst_devices", "_semantics")
+                 "nbytes", "wire", "_dst_devices", "_semantics")
 
     def __init__(self, aval, src_sharding, dst_sharding):
         self.dst_sharding = dst_sharding
         self.src_sharding = src_sharding
+        # (max_link_messages, max_link_bytes) for the "link" wire model;
+        # set by make_transfer from the planner's link stats
+        self.wire = None
         self.ndim = len(getattr(aval, "shape", ()))
         shape = tuple(getattr(aval, "shape", ()))
         try:
@@ -622,7 +1013,7 @@ class DirectTransfer:
         if out is None:
             import jax
             out = jax.device_put(val, self.dst_sharding)
-        _apply_sync_semantics(out)
+        _apply_sync_semantics(out, wire=self.wire)
         return out
 
 
@@ -669,9 +1060,124 @@ class DirectTransferGroup:
         if out is None:
             import jax
             out = jax.device_put(list(vals), [t.dst_sharding for t in ts])
-        # one emulated wire round-trip for the whole coalesced message
-        _apply_sync_semantics(out)
+        # one emulated wire round-trip for the whole coalesced message;
+        # under the link model, member messages on a link still queue
+        wires = [t.wire for t in ts if t.wire is not None]
+        wire = (sum(w[0] for w in wires),
+                sum(w[1] for w in wires)) if wires else None
+        _apply_sync_semantics(out, wire=wire)
         return out
+
+
+class CollectiveTransfer:
+    """Pre-resolved executor for one RESHARD edge lowered to a two-leg
+    collective sequence (ISSUE 7; "Memory-efficient array redistribution
+    through portable collective communication", PAPERS.md):
+
+    1. **wire leg** — ``jax.device_put`` to the *landing* sharding on the
+       destination mesh (the 1/k scattered layout for
+       ``slice_all_gather`` / ``reduce_scatter_gather``, the translated
+       source layout for ``all_to_all``), so only the strategy's reduced
+       byte volume crosses meshes;
+    2. **collective leg** — a cached identity ``jax.jit`` with
+       ``out_shardings=dst_sharding``: XLA emits the intra-destination
+       all-gather / all-to-all over the mesh's own links (the same
+       lowering will emit real DCN collectives on multi-host, ROADMAP
+       item 1).
+
+    Both legs are pure data movement — no arithmetic — so every strategy
+    here is bit-exact against ``direct_p2p``.  The emulated wire idle is
+    applied to the wire leg only, scaled by this strategy's busiest-link
+    message count under the ``"link"`` wire model.
+    """
+
+    __slots__ = ("strategy", "dst_sharding", "src_sharding",
+                 "inter_sharding", "ndim", "nbytes", "wire", "fast",
+                 "_relayout")
+
+    def __init__(self, aval, src_sharding, dst_sharding, strategy,
+                 inter_sharding, wire=None):
+        self.strategy = strategy
+        self.dst_sharding = dst_sharding
+        self.src_sharding = src_sharding
+        self.inter_sharding = inter_sharding
+        self.ndim = len(getattr(aval, "shape", ()))
+        self.fast = False   # never the batched-copy fast path
+        shape = tuple(getattr(aval, "shape", ()))
+        try:
+            self.nbytes = int(np.prod(shape, dtype=np.int64) *
+                              np.dtype(aval.dtype).itemsize)
+        except Exception:  # pylint: disable=broad-except
+            self.nbytes = 0
+        self.wire = wire
+        self._relayout = None
+
+    def __call__(self, val):
+        if _ttrace.enabled():
+            with _ttrace.get_recorder().span(
+                    "reshard.edge", "resharding",
+                    {"bytes": self.nbytes, "strategy": self.strategy}):
+                return self._transfer(val)
+        return self._transfer(val)
+
+    def _transfer(self, val):
+        import jax
+        staged = jax.device_put(val, self.inter_sharding)
+        _apply_sync_semantics(staged, wire=self.wire)
+        if self._relayout is None:
+            self._relayout = jax.jit(lambda x: x,
+                                     out_shardings=self.dst_sharding)
+        return self._relayout(staged)
+
+
+def make_transfer(aval, src_sharding, dst_sharding, cross=False,
+                  plan=None, weight=False):
+    """Executor factory for one RESHARD edge: DirectTransfer,
+    CollectiveTransfer, or (opt-in) the quantized codec transfer.
+
+    Same-mesh relayouts always stay direct.  Cross-mesh edges take the
+    plan's strategy decision when a :class:`ReshardingTaskSpec` is given
+    (so the emitter replays exactly what the planner chose and cached),
+    else resolve it here.  The quantized codec
+    (``global_config.reshard_quantize``) takes precedence for eligible
+    activation edges but is NEVER applied when ``weight`` is True —
+    microbatch-invariant values (parameters, optimizer state) must cross
+    losslessly.  Any planning failure degrades to DirectTransfer."""
+    if not cross or src_sharding is None:
+        return DirectTransfer(aval, src_sharding, dst_sharding)
+    from alpa_tpu.global_env import global_config
+    shape = tuple(getattr(aval, "shape", ()))
+    try:
+        itemsize = int(np.dtype(aval.dtype).itemsize)
+        qmode = getattr(global_config, "reshard_quantize", "off")
+        if qmode != "off" and not weight:
+            from alpa_tpu.pipeline_parallel import reshard_codec
+            qt = reshard_codec.maybe_quantized_transfer(
+                aval, src_sharding, dst_sharding, qmode)
+            if qt is not None:
+                return qt
+        opts = collective_options(shape, itemsize, src_sharding,
+                                  dst_sharding)
+        if plan is not None and getattr(plan, "strategy", None) in opts:
+            strat = plan.strategy
+        else:
+            strat, _costs, _cached = resolve_strategy(
+                shape, itemsize, src_sharding, dst_sharding)
+            if strat not in opts:
+                strat = "direct_p2p"
+        st = opts[strat]["stats"]
+        wire = (st["max_link_messages"], st["max_link_bytes"])
+        if strat == "direct_p2p":
+            t = DirectTransfer(aval, src_sharding, dst_sharding)
+            t.wire = wire
+            return t
+        return CollectiveTransfer(aval, src_sharding, dst_sharding,
+                                  strat, opts[strat]["landing"],
+                                  wire=wire)
+    except Exception:  # pylint: disable=broad-except
+        logger.warning("make_transfer: collective lowering failed; "
+                       "using DirectTransfer", exc_info=True)
+        return DirectTransfer(aval, src_sharding, dst_sharding)
 
 
 @dataclasses.dataclass

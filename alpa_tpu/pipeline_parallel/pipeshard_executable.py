@@ -32,11 +32,18 @@ from alpa_tpu.pipeline_parallel.runtime_emitter import (
 from alpa_tpu.pipeline_parallel.schedules import create_pipeline_schedule
 from alpa_tpu.shard_parallel.auto_sharding import MESH_AXIS_NAMES
 from alpa_tpu.telemetry import flight as _flight
+from alpa_tpu.telemetry import metrics as _tmetrics
 from alpa_tpu.telemetry import trace as _ttrace
-from alpa_tpu.timer import timers, tracer
 from alpa_tpu.util import OrderedSet
 
 logger = logging.getLogger(__name__)
+
+# driver-side dispatch latency of one pipeshard step (the whole
+# instruction replay, not device wall clock) — replaces the deprecated
+# timers("pipeshard-dispatch") bridge
+_DISPATCH_SECONDS = _tmetrics.get_registry().histogram(
+    "alpa_pipeshard_dispatch_seconds",
+    "launch_on_driver dispatch latency per pipeshard step")
 
 
 class StageExecutable:
@@ -596,8 +603,7 @@ class PipeshardDriverExecutable:
         self._launch_gate.wait()
         with self._quiesce_cv:
             self._inflight_launches += 1
-        timer = timers("pipeshard-dispatch")
-        timer.start()
+        t0 = time.perf_counter()
         step_span = _ttrace.begin("pipeshard.step", "runtime")
         try:
             return self._launch(*flat_args)
@@ -608,7 +614,7 @@ class PipeshardDriverExecutable:
             raise
         finally:
             _ttrace.end(step_span)
-            timer.stop()
+            _DISPATCH_SECONDS.observe(time.perf_counter() - t0)
             with self._quiesce_cv:
                 self._inflight_launches -= 1
                 self._quiesce_cv.notify_all()
@@ -1308,6 +1314,9 @@ class PipeshardDriverExecutable:
         # "M" records are per-track metadata the recorder always emits;
         # real content is spans/instants/counters
         timed = [e for e in all_events if e.get("ph") != "M"]
+        # deprecated bridge, imported lazily: third-party code may still
+        # log through alpa_tpu.timer.tracer and expects to land here
+        from alpa_tpu.timer import tracer
         legacy = tracer.to_chrome_trace()
         if not timed and not legacy:
             mode = (getattr(self, "last_dispatch_stats", None)

@@ -1109,7 +1109,7 @@ def lower_to_register_file(
       and everything else execute synchronously in flat relative order.
     """
     from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
-        DirectTransfer, DirectTransferGroup)
+        DirectTransfer, DirectTransferGroup, make_transfer)
 
     if mode not in ("registers", "overlap"):
         raise ValueError(f"unknown lowering mode: {mode!r}")
@@ -1175,13 +1175,26 @@ def lower_to_register_file(
             v = inst.var_key[0]
             ss = slot((v, inst.var_key[1], inst.src_mesh))
             ds = slot((v, inst.var_key[1], inst.dst_mesh))
-            t = DirectTransfer(v.aval, cur_sharding.get(ss),
-                               inst.dst_sharding)
+            # collective lowering (ISSUE 7): the factory replays the
+            # planner's per-edge strategy (DirectTransfer,
+            # CollectiveTransfer, or the opt-in quantized codec); weight
+            # edges (microbatch-invariant, var_key[1] < 0) stay lossless
+            t = make_transfer(v.aval, cur_sharding.get(ss),
+                              inst.dst_sharding,
+                              cross=inst.src_mesh != inst.dst_mesh,
+                              plan=inst.plan,
+                              weight=inst.var_key[1] < 0)
+            strategy = getattr(t, "strategy", None) or \
+                ("quantized" if not isinstance(t, DirectTransfer)
+                 else "direct_p2p")
+            tag = "" if strategy == "direct_p2p" else f" [{strategy}]"
             cur_sharding[ds] = inst.dst_sharding
             recs.append({
                 "kind": "RESHARD",
                 "op": _make_reshard_op(t, ss, ds),
                 "transfer": t,
+                # only DirectTransfers coalesce into batched groups
+                "groupable": isinstance(t, DirectTransfer),
                 "ss": ss,
                 "ds": ds,
                 "edge": (inst.src_mesh, inst.dst_mesh),
@@ -1189,14 +1202,17 @@ def lower_to_register_file(
                 "reads": (ss,),
                 "writes": (ds,),
                 "kills": (),
-                "name": f"RESHARD {inst.src_mesh}->{inst.dst_mesh}",
+                "name": f"RESHARD {inst.src_mesh}->{inst.dst_mesh}{tag}",
                 "mesh": inst.dst_mesh,
                 "site": "cross_mesh_send",
                 "finfo": {"var": str(v), "src_mesh": inst.src_mesh,
-                          "dst_mesh": inst.dst_mesh},
+                          "dst_mesh": inst.dst_mesh,
+                          "strategy": strategy},
                 "idem": True,
                 "line": (f"RESHARD {inst.var_key} {inst.src_mesh}->"
-                         f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}"),
+                         f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}" +
+                         ("" if strategy == "direct_p2p"
+                          else f" strategy={strategy}")),
             })
         else:  # FREE
             by_opcode["FREE"] += 1
@@ -1287,7 +1303,9 @@ def lower_to_register_file(
             j = i
             while j < n:
                 q = recs[j]
-                if q["kind"] == "RESHARD" and q["edge"] == edge:
+                if (q["kind"] == "RESHARD" and q["edge"] == edge and
+                        (j == i or (r.get("groupable", True) and
+                                    q.get("groupable", True)))):
                     if q["ss"] in blocked or q["ds"] in blocked:
                         break   # would reorder past a FREE of its slots
                     if len(hopped) > counted:
@@ -1343,13 +1361,14 @@ def lower_to_register_file(
         k = 0
         while k < len(plan):
             kind, idx = plan[k]
-            if kind != "launch":
+            if kind != "launch" or not recs[idx].get("groupable", True):
                 k += 1
                 continue
             edge = recs[idx]["edge"]
             mem = [idx]
             k2 = k + 1
             while (k2 < len(plan) and plan[k2][0] == "launch" and
+                   recs[plan[k2][1]].get("groupable", True) and
                    recs[plan[k2][1]]["edge"] == edge):
                 mem.append(plan[k2][1])
                 k2 += 1

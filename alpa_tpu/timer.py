@@ -1,8 +1,23 @@
-"""Named timers and an event tracer.
+"""Deprecation bridge over the unified telemetry layer.
 
-TPU-native analog of the reference ``alpa/timer.py:7-94``.  ``sync_func`` on
-TPU blocks on outstanding device work via ``jax.block_until_ready`` /
-``jax.effects_barrier`` rather than cudaDeviceSynchronize.
+.. deprecated::
+    ``alpa_tpu.timer`` predates ``alpa_tpu.telemetry`` (the reference's
+    ``alpa/timer.py:7-94``).  The runtime no longer uses it — dispatch
+    latency lives in the ``alpa_pipeshard_dispatch_seconds`` /
+    ``alpa_mesh_dispatch_seconds`` histograms and step timelines in
+    ``telemetry.trace`` — but the module stays importable for
+    third-party call sites:
+
+    * ``timers(name).start()/.stop()`` keeps working and additionally
+      mirrors each measured interval into the central metrics registry
+      as the ``alpa_legacy_timer_seconds{name}`` histogram, so legacy
+      timings show up on GET /metrics next to everything else.
+    * ``tracer.log(...)`` keeps its local event list and mirrors into
+      the process ``TraceRecorder`` as a ``legacy``-category instant
+      when tracing is enabled (same merged Perfetto trace as
+      span-instrumented code).
+
+    New code should use ``alpa_tpu.telemetry.metrics`` / ``.trace``.
 """
 import time
 from collections import namedtuple
@@ -10,8 +25,18 @@ from collections import namedtuple
 TracerEvent = namedtuple("TracerEvent", ("tstamp", "name", "info"))
 
 
+def _legacy_histogram():
+    # lazy so ``alpa_tpu.timer`` stays importable alone
+    from alpa_tpu.telemetry import metrics as _tmetrics
+    return _tmetrics.get_registry().histogram(
+        "alpa_legacy_timer_seconds",
+        "Intervals measured through the deprecated alpa_tpu.timer bridge",
+        labelnames=("name",))
+
+
 class _Timer:
-    """A named timer with start/stop/elapsed, mirroring ref timer semantics."""
+    """A named timer with start/stop/elapsed (deprecated; kept for API
+    compatibility — each stop also feeds the telemetry histogram)."""
 
     def __init__(self, name: str):
         self.name = name
@@ -31,8 +56,13 @@ class _Timer:
         assert self.started, f"timer {self.name} not started"
         if sync_func:
             sync_func()
-        self.costs.append(time.perf_counter() - self.start_time)
+        dt = time.perf_counter() - self.start_time
+        self.costs.append(dt)
         self.started = False
+        try:
+            _legacy_histogram().labels(self.name).observe(dt)
+        except Exception:  # pylint: disable=broad-except
+            pass
 
     def reset(self):
         self.started = False
@@ -55,7 +85,7 @@ class _Timer:
 
 
 class Timers:
-    """A registry of named timers (ref: alpa/timer.py Timers)."""
+    """A registry of named timers (deprecated shim)."""
 
     def __init__(self):
         self.timers = {}
@@ -78,25 +108,15 @@ class Timers:
 
 
 class Tracer:
-    """Timestamped event log, dumpable as a Chrome trace
-    (ref: alpa/timer.py:81-94 + pipeshard_executable.py:592).
-
-    .. deprecated::
-        Kept as a compatibility shim over the unified telemetry layer
-        (``alpa_tpu.telemetry``): when tracing is enabled, every
-        ``log()`` is mirrored into the process ``TraceRecorder`` as a
-        ``legacy``-category instant, so old call sites land in the same
-        merged Perfetto trace as span-instrumented code.  New code
-        should use ``telemetry.trace`` directly.
-    """
+    """Timestamped event log, dumpable as a Chrome trace (deprecated
+    shim: when tracing is enabled every ``log()`` is mirrored into the
+    process ``TraceRecorder`` as a ``legacy``-category instant)."""
 
     def __init__(self):
         self.events = []
 
     def log(self, name: str, info: str = ""):
         self.events.append(TracerEvent(time.time(), name, info))
-        # bridge into the unified trace (no-op when tracing is off);
-        # imported lazily so ``alpa_tpu.timer`` stays importable alone
         from alpa_tpu.telemetry import trace as _ttrace
         if _ttrace.enabled():
             _ttrace.instant(name, "legacy",
@@ -106,11 +126,8 @@ class Tracer:
         self.events = []
 
     def to_chrome_trace(self, pid: int = 0):
-        """Render events as Chrome trace 'instant' records.
-
-        .. deprecated:: prefer ``telemetry.trace.TraceRecorder.
-           to_chrome_trace()``, which carries spans and counters too.
-        """
+        """Render events as Chrome trace 'instant' records (deprecated:
+        prefer ``telemetry.trace.TraceRecorder.to_chrome_trace()``)."""
         return [{
             "name": ev.name,
             "ph": "i",

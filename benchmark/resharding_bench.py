@@ -25,10 +25,26 @@ Two ISSUE 4 sweeps ride along and write
   copies are async in-process memcpys, so wire latency is
   reintroduced explicitly; see bench_dispatch.run_reshard_heavy).
 
+The ISSUE 7 sweeps write ``benchmark/results/resharding_collectives.json``:
+
+* ``--strategy`` — per case, wall clock of every eligible lowering
+  strategy (direct_p2p vs slice_all_gather / all_to_all /
+  reduce_scatter_gather executors) under the ``link`` wire model at
+  0.5 ms and 2 ms emulated per-message latency, plus the cost model's
+  auto choice.
+* ``--quantize`` — the int8 (and fp8 when available) codec on an fp32
+  edge: wire-byte reduction vs lossless, wall clock vs direct, and the
+  observed round-trip error against the documented bound.
+* warm-restart replay: per-edge strategy decisions are re-planned from
+  a fresh process-state against the same disk compile cache and must
+  reproduce an identical plan fingerprint with every edge a cache hit.
+
 Usage:
   python benchmark/resharding_bench.py [--devices 8] [--mb 64]
       [--json benchmark/results/resharding_overlap.json]
-      [--skip-overlap]
+      [--collectives-json benchmark/results/resharding_collectives.json]
+      [--strategy sweep|<name>] [--quantize sweep|int8|fp8|off]
+      [--skip-overlap] [--skip-strategy]
 """
 import argparse
 import json
@@ -75,6 +91,211 @@ def sweep_loadbalance(shape, src_mesh, dst_mesh, cases):
     return out
 
 
+def _time_transfer(transfer, val, niter):
+    """Best-of-niter wall clock of one edge executor (seconds)."""
+    import jax
+    out = transfer(val)              # warmup: compiles any jitted leg
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(niter):
+        tic = time.perf_counter()
+        out = transfer(val)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - tic)
+    return best
+
+
+def sweep_strategies(shape, src_mesh, dst_mesh, cases, niter,
+                     latencies, which="sweep"):
+    """Wall clock of every eligible strategy per case under the ``link``
+    wire model (ISSUE 7 acceptance: collectives must beat direct_p2p on
+    the fan-out and transpose-shaped edges at 2 ms)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel import cross_mesh_resharding as cmr
+
+    class _Aval:
+        def __init__(self, s):
+            self.shape = s
+            self.dtype = np.dtype(np.float32)
+
+    x = jnp.arange(int(np.prod(shape)), dtype=jnp.float32).reshape(shape)
+    out = {}
+    prev = (global_config.resharding_wire_model,
+            global_config.resharding_transfer_latency_s,
+            global_config.reshard_strategy)
+    try:
+        global_config.resharding_wire_model = "link"
+        for lat in latencies:
+            global_config.resharding_transfer_latency_s = lat
+            key = f"latency_{lat * 1e3:g}ms"
+            out[key] = {}
+            for name, src_spec, dst_spec in cases:
+                src_sh = NamedSharding(src_mesh, src_spec)
+                dst_sh = NamedSharding(dst_mesh, dst_spec)
+                val = jax.device_put(x, src_sh)
+                global_config.reshard_strategy = "auto"
+                auto, _costs, opts = cmr.choose_strategy(
+                    shape, 4, src_sh, dst_sh)
+                entry = {"auto_choice": auto, "wall_ms": {}, "wire": {}}
+                for strat, o in opts.items():
+                    if which not in ("sweep", strat):
+                        continue
+                    global_config.reshard_strategy = strat
+                    t = cmr.make_transfer(_Aval(shape), src_sh, dst_sh,
+                                          cross=True)
+                    got = getattr(t, "strategy", "direct_p2p")
+                    assert got == strat, (name, strat, got)
+                    ref = np.asarray(x)
+                    res = t(val)
+                    np.testing.assert_array_equal(np.asarray(res), ref)
+                    st = o["stats"]
+                    entry["wall_ms"][strat] = round(
+                        _time_transfer(t, val, niter) * 1e3, 3)
+                    entry["wire"][strat] = {
+                        "max_link_messages": st["max_link_messages"],
+                        "max_link_bytes": st["max_link_bytes"],
+                        "total_bytes": st["total_bytes"],
+                    }
+                wall = entry["wall_ms"]
+                if "direct_p2p" in wall and len(wall) > 1:
+                    best = min((v, k) for k, v in wall.items())
+                    entry["best"] = best[1]
+                    entry["speedup_vs_direct"] = round(
+                        wall["direct_p2p"] / best[0], 2) if best[0] else 1.0
+                out[key][name] = entry
+    finally:
+        (global_config.resharding_wire_model,
+         global_config.resharding_transfer_latency_s,
+         global_config.reshard_strategy) = prev
+    return out
+
+
+def sweep_quantize(shape, src_mesh, dst_mesh, niter, which="sweep"):
+    """The transfer codec on the fan-out fp32 edge: wire-byte reduction,
+    wall clock vs lossless direct at 2 ms, observed error vs bound."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel import cross_mesh_resharding as cmr
+    from alpa_tpu.pipeline_parallel import reshard_codec as codec
+
+    class _Aval:
+        def __init__(self, s):
+            self.shape = s
+            self.dtype = np.dtype(np.float32)
+
+    src_sh = NamedSharding(src_mesh, P("d", None))
+    dst_sh = NamedSharding(dst_mesh, P(None, None))
+    rng = np.random.default_rng(0)
+    xn = rng.standard_normal(shape).astype(np.float32)
+    x = jax.device_put(jnp.asarray(xn), src_sh)
+    nbytes = xn.nbytes
+    modes = [m for m in ("int8", "fp8")
+             if which in ("sweep", m) and
+             (m != "fp8" or codec.have_fp8())]
+    prev = (global_config.resharding_wire_model,
+            global_config.resharding_transfer_latency_s,
+            global_config.reshard_strategy)
+    out = {"case": "rowshard->replicated", "payload_bytes": nbytes,
+           "codecs": {}}
+    try:
+        global_config.resharding_wire_model = "link"
+        global_config.resharding_transfer_latency_s = 0.002
+        global_config.reshard_strategy = "direct_p2p"
+        direct = cmr.make_transfer(_Aval(shape), src_sh, dst_sh,
+                                   cross=True)
+        out["direct_wall_ms"] = round(
+            _time_transfer(direct, x, niter) * 1e3, 3)
+        for mode in modes:
+            t = codec.maybe_quantized_transfer(_Aval(shape), src_sh,
+                                               dst_sh, mode)
+            assert t is not None
+            res = np.asarray(t(x))
+            # per-block error against the documented bound
+            flat = xn.reshape(-1)
+            nb = -(-flat.size // codec.BLOCK)
+            blocks = np.pad(flat, (0, nb * codec.BLOCK - flat.size)) \
+                .reshape(nb, codec.BLOCK)
+            amax = np.abs(blocks).max(axis=1)
+            err = np.abs(res.reshape(-1) - flat)
+            err_blocks = np.pad(err, (0, nb * codec.BLOCK - err.size)) \
+                .reshape(nb, codec.BLOCK).max(axis=1)
+            frac = 1 / 254 if mode == "int8" else 0.07
+            wb = t.wire_nbytes
+            out["codecs"][mode] = {
+                "wire_bytes": wb,
+                "reduction_vs_fp32": round(nbytes / wb, 2),
+                "wall_ms": round(_time_transfer(t, x, niter) * 1e3, 3),
+                "max_abs_err": float(err.max()),
+                "bound_frac_of_block_max": frac,
+                "within_bound": bool(
+                    (err_blocks <= amax * frac + 1e-6).all()),
+            }
+    finally:
+        (global_config.resharding_wire_model,
+         global_config.resharding_transfer_latency_s,
+         global_config.reshard_strategy) = prev
+    return out
+
+
+def check_warm_restart(shape, src_mesh, dst_mesh, cases):
+    """Plan every case twice against one disk compile cache with the
+    in-memory tier dropped in between: the second pass must be all
+    cache hits with an identical plan fingerprint."""
+    import tempfile
+
+    from jax.sharding import NamedSharding
+
+    from alpa_tpu.compile_cache import reset_compile_cache
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel import cross_mesh_resharding as cmr
+
+    prev = (global_config.compile_cache_dir,
+            global_config.resharding_wire_model,
+            global_config.resharding_transfer_latency_s)
+    tmp = tempfile.mkdtemp(prefix="reshard_cache_")
+    try:
+        global_config.compile_cache_dir = tmp
+        global_config.resharding_wire_model = "link"
+        global_config.resharding_transfer_latency_s = 0.002
+        reset_compile_cache()
+
+        def plan_all():
+            cmr.reset_recent_plans()
+            specs = [cmr.plan_resharding(
+                shape, 4, NamedSharding(src_mesh, s),
+                NamedSharding(dst_mesh, d)) for _, s, d in cases]
+            return specs, cmr.strategy_plan_fingerprint()
+
+        cold_specs, cold_fp = plan_all()
+        # simulate a restart: drop the in-memory tier, keep the disk
+        reset_compile_cache()
+        warm_specs, warm_fp = plan_all()
+        return {
+            "edges": len(cases),
+            "cold_fingerprint": cold_fp,
+            "warm_fingerprint": warm_fp,
+            "identical": cold_fp == warm_fp,
+            "warm_all_cached": all(s.strategy_cached
+                                   for s in warm_specs),
+            "strategies": {name: s.strategy for (name, _, _), s in
+                           zip(cases, cold_specs)},
+        }
+    finally:
+        (global_config.compile_cache_dir,
+         global_config.resharding_wire_model,
+         global_config.resharding_transfer_latency_s) = prev
+        reset_compile_cache()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--devices", type=int, default=8,
@@ -88,6 +309,16 @@ def main():
     parser.add_argument("--skip-overlap", action="store_true",
                         help="skip the pipeshard overlap-dispatch sweep "
                              "(it compiles a full pipelined step)")
+    parser.add_argument("--collectives-json", default=os.path.join(
+        REPO, "benchmark", "results", "resharding_collectives.json"))
+    parser.add_argument("--strategy", default="sweep",
+                        help="strategy sweep: 'sweep' (all eligible), a "
+                             "single strategy name, or 'off'")
+    parser.add_argument("--quantize", default="sweep",
+                        choices=("sweep", "int8", "fp8", "off"),
+                        help="codec sweep: both codecs, one, or off")
+    parser.add_argument("--skip-strategy", action="store_true",
+                        help="skip the ISSUE 7 collective sweeps")
     args = parser.parse_args()
 
     if os.environ.get("JAX_PLATFORMS") != "tpu":
@@ -182,6 +413,33 @@ def main():
     with open(args.json, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
+
+    # -- ISSUE 7 sweeps -> resharding_collectives.json ----------------
+    if args.skip_strategy or args.strategy == "off":
+        return
+    # smaller payload than the mode matrix: the sweeps compare emulated
+    # wire idles (0.5-2 ms/message), which a multi-MB CPU memcpy would
+    # drown out
+    srows = max(half * 4, 1024 // 8 * 8)
+    sshape = (srows, srows)
+    col_report = {
+        "payload": f"{srows}x{srows} f32 across two {half}-device "
+                   "meshes",
+        "wire_model": "link (idle = latency x busiest-link messages "
+                      "per transfer)",
+        "strategy_sweep": sweep_strategies(
+            sshape, src_mesh, dst_mesh, cases, args.niter,
+            latencies=(0.0005, 0.002), which=args.strategy),
+        "warm_restart": check_warm_restart(sshape, src_mesh, dst_mesh,
+                                           cases),
+    }
+    if args.quantize != "off":
+        col_report["quantize"] = sweep_quantize(
+            sshape, src_mesh, dst_mesh, args.niter, which=args.quantize)
+    os.makedirs(os.path.dirname(args.collectives_json), exist_ok=True)
+    with open(args.collectives_json, "w", encoding="utf-8") as f:
+        json.dump(col_report, f, indent=1)
+    print(json.dumps(col_report, indent=1))
 
 
 if __name__ == "__main__":

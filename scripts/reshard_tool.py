@@ -1,0 +1,113 @@
+"""Inspect the collective resharding planner (ISSUE 7).
+
+Usage::
+
+    python scripts/reshard_tool.py plan --shape 1024,1024 \
+        --src-devices 4 --dst-devices 4 \
+        --src-spec x,None --dst-spec None,None \
+        [--dtype float32] [--latency-ms 2.0] [--bandwidth 0] \
+        [--wire-model link]
+
+``plan`` plans one cross-mesh edge with :func:`plan_resharding` and
+prints the chosen strategy, every candidate's estimated cost and
+busiest-link load, and the planned wire bytes — the same per-edge
+decision `dump_debug_info` records as ``resharding_plan.txt``.
+
+Spec syntax: comma-separated PartitionSpec entries over the 1-D device
+axis ``x`` (``x`` = sharded on that dim, ``None`` = replicated), e.g.
+``x,None`` is a row shard.  Runs on the CPU backend with emulated
+devices; the planner's tiling math is device-count-driven, so the
+decisions match what the real meshes would get.
+"""
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _parse_spec(text, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+    entries = [None if e in ("None", "none", "") else e
+               for e in text.split(",")]
+    return NamedSharding(mesh, PartitionSpec(*entries))
+
+
+def cmd_plan(args):
+    n_dev = args.src_devices + args.dst_devices
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") +
+        f" --xla_force_host_platform_device_count={n_dev}")
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel import cross_mesh_resharding as cmr
+
+    global_config.resharding_transfer_latency_s = args.latency_ms / 1e3
+    global_config.resharding_wire_bandwidth = args.bandwidth
+    global_config.resharding_wire_model = args.wire_model
+
+    devices = jax.devices()
+    if len(devices) < n_dev:
+        sys.exit(f"need {n_dev} devices, have {len(devices)}")
+    src_mesh = Mesh(np.array(devices[:args.src_devices]), ("x",))
+    dst_mesh = Mesh(np.array(devices[args.src_devices:n_dev]), ("x",))
+    shape = tuple(int(s) for s in args.shape.split(","))
+    itemsize = np.dtype(args.dtype).itemsize
+    src = _parse_spec(args.src_spec, src_mesh)
+    dst = _parse_spec(args.dst_spec, dst_mesh)
+
+    spec = cmr.plan_resharding(shape, itemsize, src, dst)
+    print(f"edge: {shape} {args.dtype} "
+          f"{cmr._sharding_key(src)} -> {cmr._sharding_key(dst)}")
+    print(f"wire model: {args.wire_model}  "
+          f"latency={args.latency_ms}ms  bandwidth={args.bandwidth}")
+    print(f"chosen strategy: {spec.strategy}"
+          f"{' (from compile cache)' if spec.strategy_cached else ''}")
+    print(f"planned cross-mesh bytes: {spec.transfer_bytes:.0f} "
+          f"(broadcast {spec.broadcast_bytes:.0f}); "
+          f"max-link {spec.max_link_bytes:.0f} B "
+          f"(naive {spec.max_link_bytes_naive:.0f} B)")
+    print("candidates:")
+    for name, stats in spec.strategy_stats.items():
+        cost = spec.strategy_costs.get(name)
+        cost_s = f"{cost * 1e3:.3f}ms" if cost is not None else "n/a"
+        mark = " <-- chosen" if name == spec.strategy else ""
+        print(f"  {name:<22} est={cost_s:>10}  "
+              f"link_msgs={stats['max_link_messages']:>3}  "
+              f"link_bytes={stats['max_link_bytes']:>10.0f}  "
+              f"wire_total={stats['total_bytes']:>10.0f}{mark}")
+    print()
+    print(cmr.format_resharding_plan())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pp = sub.add_parser("plan", help="plan one cross-mesh edge and "
+                        "print the strategy decision")
+    pp.add_argument("--shape", default="1024,1024",
+                    help="global array shape, comma-separated")
+    pp.add_argument("--dtype", default="float32")
+    pp.add_argument("--src-devices", type=int, default=4)
+    pp.add_argument("--dst-devices", type=int, default=4)
+    pp.add_argument("--src-spec", default="x,None",
+                    help="source PartitionSpec entries, e.g. x,None")
+    pp.add_argument("--dst-spec", default="None,None")
+    pp.add_argument("--latency-ms", type=float, default=2.0,
+                    help="emulated per-message wire latency")
+    pp.add_argument("--bandwidth", type=float, default=0.0,
+                    help="emulated per-link bandwidth, bytes/s (0 = off)")
+    pp.add_argument("--wire-model", default="link",
+                    choices=("call", "link"))
+    pp.set_defaults(fn=cmd_plan)
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -354,6 +354,29 @@ class TestLinkAccounting:
         loads = compute_link_loads(spec, broadcast=False)
         assert task.last_report.max_link_bytes == loads["max_link_bytes"]
 
+    def test_pinned_slice_all_gather_strategy_stats(self):
+        """Collective lowering (ISSUE 7): for the same pinned 4+4 plan
+        the ``slice_all_gather`` wire leg must move the whole array
+        exactly once — at most the pinned 256 B broadcast figure — with
+        one 64 B message per link; ``direct_p2p`` pays 4 messages and
+        256 B on the busiest link.  A selection or link-stats regression
+        fails here."""
+        spec, _, _ = self._spec()
+        stats = spec.strategy_stats
+        assert {"direct_p2p", "slice_all_gather"} <= set(stats)
+        sag = stats["slice_all_gather"]
+        assert sag["total_bytes"] == self.S == 256     # ≤ broadcast 256
+        assert sag["max_link_bytes"] == self.S / 4 == 64
+        assert sag["max_link_messages"] == 1
+        direct = stats["direct_p2p"]
+        assert direct["total_bytes"] == 4 * self.S == 1024
+        assert direct["max_link_bytes"] == self.S == 256
+        assert direct["max_link_messages"] == 4
+        # default knobs (no emulated latency): ties resolve to direct,
+        # keeping the default path byte-identical to pre-ISSUE-7
+        assert spec.strategy == "direct_p2p"
+        assert set(spec.strategy_costs) == set(stats)
+
     def test_planner_counters_accumulate(self):
         from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
             get_planner_stats, reset_planner_stats)
