@@ -1,0 +1,20 @@
+"""Static analysis over compiled pipeshard plans (ISSUE 8).
+
+Two halves:
+
+* :mod:`alpa_tpu.analysis.plan_verifier` — a typed abstract
+  interpretation over every lowered
+  :class:`~alpa_tpu.pipeline_parallel.runtime_emitter.RegisterFileProgram`
+  run at compile time: slot typing, cross-mesh deadlock freedom,
+  liveness/leaks + peak-live-bytes, and a cached
+  :class:`~alpa_tpu.analysis.plan_verifier.PlanVerdict` gating
+  compilation behind ``global_config.verify_plans``.
+* :mod:`alpa_tpu.analysis.lint` — an AST repo lint enforcing codified
+  invariants (knob/env-var/doc registration, ``alpa_*`` metric names,
+  no new legacy-timer call sites, known fault-site names), run as a
+  tier-1 test (tests/util/test_repo_lint.py) and via
+  ``scripts/verify_tool.py verify lint``.
+"""
+from alpa_tpu.analysis.plan_verifier import (  # noqa: F401
+    Finding, PlanModel, PlanVerdict, PlanVerificationError,
+    verify_model)

@@ -1,0 +1,312 @@
+"""AST repo lint: machine-checked repo invariants (ISSUE 8).
+
+The plan verifier (plan_verifier.py) checks what the *compiler emits*;
+this module checks what the *repo contains*.  Both run in tier-1, so an
+invariant violation fails CI instead of surviving as a convention that
+only holds until the next contributor misses it.
+
+Checked invariants (one code per rule):
+
+``config-env``
+    Every knob assigned in ``GlobalConfig.__init__`` must be seeded from
+    an ``ALPA_TPU_*`` environment variable (the expression must contain
+    an ``ALPA_TPU_*`` string literal).  Keeps every flag settable
+    without code edits on a multi-host deployment, where env vars are
+    the only config channel that reaches every process.
+
+``config-doc``
+    Every knob name must appear somewhere under ``docs/*.md`` (the knob
+    reference table in docs/architecture.md satisfies this for all of
+    them).
+
+``metric-name``
+    Metric families registered through the telemetry registry
+    (``.counter(\"name\", \"desc\", ...)`` / ``.gauge`` / ``.histogram``
+    with a literal name AND a literal description) must match
+    ``alpa_[a-z0-9_]*`` so the /metrics namespace stays coherent.
+    Trace-counter calls (``ttrace.counter(name, value)``) have no
+    literal description and are not metric families.
+
+``timer-import``
+    No new imports of the deprecated ``alpa_tpu.timer`` bridge outside
+    the two grandfathered call sites (the package re-export and the
+    pipeshard deprecation shim).  New code uses ``alpa_tpu.telemetry``.
+
+``fault-site``
+    String-literal site names handed to ``fault.fire(...)`` or passed
+    as ``site=`` / ``fault_site=`` keywords must be registered in
+    ``fault.KNOWN_SITES`` — a typo'd site would otherwise never fire
+    under any fault plan and never be caught.
+
+Usage::
+
+    from alpa_tpu.analysis import lint
+    violations = lint.run_lint()        # [] when the repo is clean
+
+Run standalone via ``python scripts/verify_tool.py verify lint``; run
+in CI via ``tests/util/test_repo_lint.py``.
+"""
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+__all__ = ["Violation", "run_lint", "repo_root", "TIMER_IMPORT_ALLOWLIST"]
+
+_METRIC_NAME_RE = re.compile(r"^alpa_[a-z0-9_]+$")
+_ENV_VAR_RE = re.compile(r"^ALPA_TPU_[A-Z0-9_]+$")
+
+#: Grandfathered importers of the deprecated timer bridge (repo-relative
+#: posix paths).  Do not grow this list — new code uses
+#: alpa_tpu.telemetry.
+TIMER_IMPORT_ALLOWLIST = frozenset({
+    "alpa_tpu/__init__.py",
+    "alpa_tpu/pipeline_parallel/pipeshard_executable.py",
+})
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One lint finding: ``code`` names the rule (see module docstring),
+    ``path``/``line`` point at the offending source."""
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}: [{self.code}] {self.message}"
+
+
+def repo_root() -> str:
+    """The repository root (parent of the ``alpa_tpu`` package dir)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    """Library + scripts .py files (tests excluded: they legitimately
+    use fake fault sites and scratch metric registries)."""
+    for sub in ("alpa_tpu", "scripts"):
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _parse(path: str) -> Optional[ast.AST]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        return ast.parse(src, filename=path)
+    except SyntaxError:
+        return None
+
+
+def _str_constants(node: ast.AST) -> Iterable[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value
+
+
+def _rel(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+# ---- rule: config-env / config-doc -----------------------------------
+
+
+def _docs_text(root: str) -> str:
+    chunks = []
+    docs = os.path.join(root, "docs")
+    if os.path.isdir(docs):
+        for fn in sorted(os.listdir(docs)):
+            if fn.endswith(".md"):
+                with open(os.path.join(docs, fn), encoding="utf-8") as f:
+                    chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def _check_global_config(root: str) -> List[Violation]:
+    path = os.path.join(root, "alpa_tpu", "global_env.py")
+    tree = _parse(path)
+    if tree is None:
+        return [Violation("config-env", _rel(root, path), 1,
+                          "global_env.py failed to parse")]
+    init = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "GlobalConfig":
+            for item in node.body:
+                if (isinstance(item, ast.FunctionDef)
+                        and item.name == "__init__"):
+                    init = item
+                    break
+            break
+    if init is None:
+        return [Violation("config-env", _rel(root, path), 1,
+                          "GlobalConfig.__init__ not found")]
+    docs = _docs_text(root)
+    out: List[Violation] = []
+    for stmt in ast.walk(init):
+        if not isinstance(stmt, ast.Assign):
+            continue
+        targets = [t for t in stmt.targets
+                   if isinstance(t, ast.Attribute)
+                   and isinstance(t.value, ast.Name)
+                   and t.value.id == "self"]
+        for t in targets:
+            knob = t.attr
+            has_env = any(_ENV_VAR_RE.match(s)
+                          for s in _str_constants(stmt.value))
+            if not has_env:
+                out.append(Violation(
+                    "config-env", _rel(root, path), stmt.lineno,
+                    f"global_config.{knob} is not seeded from an "
+                    f"ALPA_TPU_* environment variable"))
+            if knob not in docs:
+                out.append(Violation(
+                    "config-doc", _rel(root, path), stmt.lineno,
+                    f"global_config.{knob} is not documented in any "
+                    f"docs/*.md (add it to the knob table in "
+                    f"docs/architecture.md)"))
+    return out
+
+
+# ---- rule: metric-name ------------------------------------------------
+
+
+def _check_metric_names(root: str, rel: str,
+                        tree: ast.AST) -> List[Violation]:
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("counter", "gauge", "histogram")):
+            continue
+        # A metric *family* registration carries (name, description):
+        # two leading string literals.  Trace counters (name, value) and
+        # dynamic names (f-strings) are out of scope.
+        if len(node.args) < 2:
+            continue
+        name_arg, desc_arg = node.args[0], node.args[1]
+        if not (isinstance(name_arg, ast.Constant)
+                and isinstance(name_arg.value, str)
+                and isinstance(desc_arg, ast.Constant)
+                and isinstance(desc_arg.value, str)):
+            continue
+        if not _METRIC_NAME_RE.match(name_arg.value):
+            out.append(Violation(
+                "metric-name", rel, node.lineno,
+                f"metric family {name_arg.value!r} does not match "
+                f"alpa_[a-z0-9_]* (keep the /metrics namespace "
+                f"coherent)"))
+    return out
+
+
+# ---- rule: timer-import ----------------------------------------------
+
+
+def _check_timer_imports(root: str, rel: str,
+                         tree: ast.AST) -> List[Violation]:
+    if rel in TIMER_IMPORT_ALLOWLIST or rel == "alpa_tpu/timer.py":
+        return []
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        hit = False
+        if isinstance(node, ast.Import):
+            hit = any(a.name == "alpa_tpu.timer" or
+                      a.name.startswith("alpa_tpu.timer.")
+                      for a in node.names)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            hit = (mod == "alpa_tpu.timer"
+                   or mod.startswith("alpa_tpu.timer.")
+                   or (mod == "alpa_tpu"
+                       and any(a.name == "timer" for a in node.names)))
+        if hit:
+            out.append(Violation(
+                "timer-import", rel, node.lineno,
+                "new import of the deprecated alpa_tpu.timer bridge "
+                "(use alpa_tpu.telemetry; allowlist in "
+                "analysis/lint.py if truly unavoidable)"))
+    return out
+
+
+# ---- rule: fault-site -------------------------------------------------
+
+
+def _known_sites() -> Set[str]:
+    from alpa_tpu.fault import KNOWN_SITES
+    return set(KNOWN_SITES)
+
+
+def _check_fault_sites(root: str, rel: str, tree: ast.AST,
+                       known: Set[str]) -> List[Violation]:
+    if rel == "alpa_tpu/fault.py":
+        return []  # the registry itself (docstring examples etc.)
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        site_literals: List[Tuple[str, int]] = []
+        # fault.fire("<site>", ...) / _fault.fire("<site>", ...)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "fire" and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            site_literals.append((node.args[0].value, node.lineno))
+        for kw in node.keywords:
+            if (kw.arg in ("site", "fault_site")
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)):
+                site_literals.append((kw.value.value, kw.value.lineno))
+        for site, line in site_literals:
+            if site not in known:
+                out.append(Violation(
+                    "fault-site", rel, line,
+                    f"fault site {site!r} is not registered in "
+                    f"fault.KNOWN_SITES (typo, or add the site to the "
+                    f"registry + docstring table)"))
+    return out
+
+
+# ---- driver -----------------------------------------------------------
+
+
+def run_lint(root: Optional[str] = None) -> List[Violation]:
+    """Run every lint rule over the repo; returns all violations
+    (empty list = clean), ordered by path then line."""
+    root = root or repo_root()
+    known = _known_sites()
+    out: List[Violation] = list(_check_global_config(root))
+    for path in _iter_py_files(root):
+        tree = _parse(path)
+        rel = _rel(root, path)
+        if tree is None:
+            out.append(Violation("parse", rel, 1, "file failed to parse"))
+            continue
+        out.extend(_check_metric_names(root, rel, tree))
+        out.extend(_check_timer_imports(root, rel, tree))
+        out.extend(_check_fault_sites(root, rel, tree, known))
+    out.sort(key=lambda v: (v.path, v.line, v.code))
+    return out
+
+
+def format_report(violations: List[Violation]) -> str:
+    if not violations:
+        return "repo lint: clean (0 violations)"
+    lines = [f"repo lint: {len(violations)} violation(s)"]
+    lines.extend(f"  {v}" for v in violations)
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":  # pragma: no cover - debugging convenience
+    import sys
+    vs = run_lint()
+    print(format_report(vs))
+    sys.exit(1 if vs else 0)

@@ -67,11 +67,24 @@ _STATE_TRANSITIONS = _tmetrics.get_registry().counter(
 
 __all__ = [
     "FaultSpec", "FaultPlan", "InjectedFault", "fire", "active_plan",
+    "KNOWN_SITES",
     "RetryPolicy", "RetryExhaustedError", "call_with_retry",
     "set_retry_policy", "get_retry_policy", "retry_stats",
     "MeshHealth", "RecoveryManager", "ServiceDegradedError",
     "make_snapshotter",
 ]
+
+#: Registry of instrumented fault sites (the table in the module
+#: docstring, machine-readable).  The repo lint checks every
+#: ``fault.fire(...)`` / ``site=...`` literal against this set, so a
+#: typo'd site name fails tier-1 instead of silently never firing.
+#: Adding a site = instrument the call point, add it here AND to the
+#: docstring table above.
+KNOWN_SITES = frozenset({
+    "probe", "stage_launch", "cross_mesh_send", "cross_mesh_recv",
+    "scheduler_take", "scheduler_tick", "distributed_init",
+    "recovery_probe",
+})
 
 
 class InjectedFault(RuntimeError):

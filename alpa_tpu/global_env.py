@@ -102,7 +102,17 @@ class GlobalConfig:
             "ALPA_TPU_DUMMY_VALUES", False)
         # Shard the apply_grad computation over the pipeline meshes instead of
         # replicating (ref: grad accumulation + apply grad placement).
-        self.pipeline_distributed_apply_grad = True
+        self.pipeline_distributed_apply_grad = _env_bool(
+            "ALPA_TPU_DISTRIBUTED_APPLY_GRAD", True)
+        # Static plan verification (ISSUE 8): every lowered register-file
+        # program runs the alpa_tpu.analysis.plan_verifier analyses (slot
+        # typing, cross-mesh deadlock freedom, liveness/leaks, structural
+        # invariants) at compile time.  "error" blocks compilation on any
+        # finding; "warn" (default) logs and continues; "off" skips.
+        # Zero dispatch-replay cost either way — the verifier never runs
+        # on the hot path.
+        self.verify_plans = os.environ.get(
+            "ALPA_TPU_VERIFY_PLANS", "warn")
         # Whether pipeshard runtime overlaps resharding with compute by
         # issuing transfers as soon as producers finish.  This is the
         # gate for the "overlap" dispatch mode under

@@ -177,6 +177,10 @@ def dump_debug_info(executable, dump_dir: str):
         write("instructions.txt", executable.get_instruction_text())
     if hasattr(executable, "get_resharding_report"):
         write("resharding.txt", executable.get_resharding_report())
+    # static plan verifier verdict (ISSUE 8): typing / deadlock /
+    # liveness / structure findings plus peak-live-bytes stats
+    if hasattr(executable, "get_plan_verdict_text"):
+        write("plan_verdict.txt", executable.get_plan_verdict_text())
     # per-edge collective strategy decisions (ISSUE 7); also printable
     # standalone via `scripts/reshard_tool.py plan`
     from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (

@@ -887,9 +887,21 @@ class PipeshardDriverExecutable:
         for v, mesh_id, _aval, sh in self.acc_allocs:
             preplaced[(v, -1, mesh_id)] = sh
 
+        # program outputs are never FREEd by design — the plan
+        # verifier's leak analysis must not flag them (ISSUE 8)
+        protected = set()
+        for spec_kind, payload in self.output_specs:
+            if spec_kind == "env":
+                (k, m) = payload
+                protected.add((k[0], k[1], m))
+            elif spec_kind == "concat":
+                v, meshes = payload
+                for mb, m in meshes:
+                    protected.add((v, mb, m))
         prog = lower_to_register_file(self.instructions, preplaced,
                                       mode=mode,
-                                      overlap_window=self._overlap_window())
+                                      overlap_window=self._overlap_window(),
+                                      protected_keys=frozenset(protected))
         self._register_programs[mode] = prog
         if mode == "registers":
             self._register_program = prog
@@ -1265,6 +1277,38 @@ class PipeshardDriverExecutable:
 
     def get_instruction_text(self) -> str:
         return "\n".join(repr(i) for i in self.instructions)
+
+    def get_plan_verdict(self, mode: str = "registers"):
+        """The static plan verifier's :class:`PlanVerdict` for the
+        lowered program (ISSUE 8), lowering on demand when no launch
+        has run yet.  None when ``verify_plans`` is off or lowering is
+        impossible (e.g. multi-process)."""
+        prog = self._register_programs.get(mode)
+        if prog is None:
+            from alpa_tpu.analysis.plan_verifier import (
+                PlanVerificationError)
+            try:
+                prog = self._ensure_lowered(mode)
+            except PlanVerificationError as e:
+                # verify_plans="error" blocks the compile, but the
+                # caller asked for a report, not a launch gate
+                return e.verdict
+            except Exception:  # pylint: disable=broad-except
+                logger.exception("get_plan_verdict: lowering failed")
+                return None
+        return getattr(prog, "verdict", None)
+
+    def get_plan_verdict_text(self) -> str:
+        """``plan_verdict.txt`` content for dump_debug_info."""
+        verdict = None
+        try:
+            verdict = self.get_plan_verdict()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("get_plan_verdict_text failed")
+        if verdict is None:
+            return ("plan verdict: (not available — verify_plans=off, "
+                    "lowering failed, or launch not register-eligible)")
+        return verdict.format_table()
 
     def get_plan_fingerprint(self) -> str:
         """Content hash of the compiled parallel plan: instruction stream

@@ -45,7 +45,8 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "FlightRecorder", "get_recorder", "set_recorder", "enabled",
-    "auto_dump", "last_dump_path", "load_dump",
+    "auto_dump", "last_dump_path", "load_dump", "annotate",
+    "get_annotations", "clear_annotations",
 ]
 
 #: on-disk dump schema version (bump on breaking change)
@@ -130,6 +131,10 @@ class FlightRecorder:
             "first_seq": events[0][0],
             "last_seq": events[-1][0],
             "written_at": time.time(),
+            # compile-time analysis notes (ISSUE 8): e.g. the plan
+            # verifier's leaked-slot var names, so a post-mortem dump
+            # says which values vanished silently at step end
+            "annotations": dict(_ANNOTATIONS),
             "events": [dict(zip(_FIELDS, e)) for e in events],
         }
         for ev in payload["events"]:
@@ -147,6 +152,25 @@ class FlightRecorder:
 _RECORDER: Optional[FlightRecorder] = None
 _LOCK = threading.Lock()
 _LAST_DUMP_PATH: Optional[str] = None
+
+# sticky analysis annotations included in every dump (survive recorder
+# swaps: the verifier runs at compile time, dumps happen much later)
+_ANNOTATIONS: Dict[str, Any] = {}
+
+
+def annotate(key: str, value: Any) -> None:
+    """Attach a compile-time note to every subsequent flight dump (the
+    plan verifier posts ``leaked_slots`` here).  Values must be
+    JSON-able."""
+    _ANNOTATIONS[key] = value
+
+
+def get_annotations() -> Dict[str, Any]:
+    return dict(_ANNOTATIONS)
+
+
+def clear_annotations() -> None:
+    _ANNOTATIONS.clear()
 
 
 def _dump_dir() -> str:

@@ -81,6 +81,12 @@ def cmd_plan(args):
               f"link_msgs={stats['max_link_messages']:>3}  "
               f"link_bytes={stats['max_link_bytes']:>10.0f}  "
               f"wire_total={stats['total_bytes']:>10.0f}{mark}")
+    if args.verify:
+        from alpa_tpu.analysis import plan_verifier
+        print("static edge verdict:")
+        for line in plan_verifier.verify_edge(shape, args.dtype, src, dst,
+                                              weight=args.weight):
+            print(f"  {line}")
     print()
     print(cmr.format_resharding_plan())
 
@@ -104,6 +110,12 @@ def main(argv=None):
                     help="emulated per-link bandwidth, bytes/s (0 = off)")
     pp.add_argument("--wire-model", default="link",
                     choices=("call", "link"))
+    pp.add_argument("--verify", action="store_true",
+                    help="append the static per-edge typing verdict "
+                         "(plan_verifier.verify_edge)")
+    pp.add_argument("--weight", action="store_true",
+                    help="treat the edge as microbatch-invariant "
+                         "(weight) payload for --verify")
     pp.set_defaults(fn=cmd_plan)
     args = p.parse_args(argv)
     args.fn(args)

@@ -1,0 +1,104 @@
+"""Static plan verifier + repo lint front-end (ISSUE 8).
+
+Usage::
+
+    python scripts/verify_tool.py verify plan [--dir DIR] [--all] [--json]
+    python scripts/verify_tool.py verify lint [--json]
+
+``verify plan`` prints the cached :class:`PlanVerdict` of every lowered
+register-file program found in the compile cache's disk tier — WITHOUT
+recompiling anything (the verifier caches verdicts under the
+``plan_verdict`` namespace at lowering time; this just reads them
+back).  The cache directory comes from ``--dir``, else
+``ALPA_TPU_CACHE_DIR``.  Default shows the newest verdict; ``--all``
+shows every cached one.  Exit status 1 when any shown verdict has
+errors.
+
+``verify lint`` runs the AST repo lint (``alpa_tpu.analysis.lint``) —
+config-knob env/doc coverage, metric naming, deprecated-timer imports,
+fault-site registry — and exits 1 on any violation.  The same lint
+gates tier-1 via ``tests/util/test_repo_lint.py``.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _age(mtime: float) -> str:
+    s = time.time() - mtime
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if s >= div:
+            return f"{s / div:.1f}{unit}"
+    return f"{s:.0f}s"
+
+
+def cmd_plan(args):
+    from alpa_tpu.analysis import plan_verifier
+    cache = None
+    if args.dir:
+        from alpa_tpu.compile_cache import CompileCache
+        cache = CompileCache(cache_dir=args.dir)
+    cached = plan_verifier.load_cached_verdicts(cache)
+    if not cached:
+        where = args.dir or os.environ.get("ALPA_TPU_CACHE_DIR") or (
+            "(memory only — set ALPA_TPU_CACHE_DIR)")
+        sys.exit(f"no cached plan verdicts in {where}; verdicts are "
+                 f"written at compile time when verify_plans != off")
+    shown = cached if args.all else cached[:1]
+    if args.json:
+        print(json.dumps([{"key": e["key"], "mtime": e["mtime"],
+                           "verdict": e["verdict"].to_dict()}
+                          for e in shown], indent=2, sort_keys=True))
+    else:
+        for e in shown:
+            print(f"== plan {e['key'][:16]}..  "
+                  f"(compiled {_age(e['mtime'])} ago) ==")
+            print(e["verdict"].format_table())
+            print()
+        if not args.all and len(cached) > 1:
+            print(f"({len(cached) - 1} older verdict(s) cached; "
+                  f"--all to show)")
+    if any(not e["verdict"].ok for e in shown):
+        sys.exit(1)
+
+
+def cmd_lint(args):
+    from alpa_tpu.analysis import lint
+    violations = lint.run_lint()
+    if args.json:
+        print(json.dumps([v.__dict__ for v in violations], indent=2,
+                         sort_keys=True))
+    else:
+        print(lint.format_report(violations))
+    if violations:
+        sys.exit(1)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    verify = sub.add_parser(
+        "verify", help="static verification entry point")
+    vsub = verify.add_subparsers(dest="what", required=True)
+    p = vsub.add_parser(
+        "plan", help="print cached plan verdicts (no recompilation)")
+    p.add_argument("--dir", default=None,
+                   help="compile cache dir (default: $ALPA_TPU_CACHE_DIR)")
+    p.add_argument("--all", action="store_true",
+                   help="show every cached verdict, not just the newest")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=cmd_plan)
+    l = vsub.add_parser("lint", help="run the AST repo lint")
+    l.add_argument("--json", action="store_true")
+    l.set_defaults(fn=cmd_lint)
+    args = parser.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,404 @@
+"""Static plan verifier (ISSUE 8 tentpole).
+
+Oracle 1: a clean real 2-mesh pipeline passes all four analyses at
+lowering time (verify_plans defaults to "warn", so the verifier runs on
+every compile).  Oracle 2: each mutation class is caught with its named
+error — swapped RECV order (deadlock.recv-before-send), dtype-corrupted
+RUN (typing.run-input-mismatch), dropped FREE (liveness.leak), a
+quantized codec on a weight edge (typing.quantized-weight-edge).
+Oracle 3: verdicts are cached in the compile cache and replayed
+identically on a warm restart, readable without recompiling
+(verify_tool's path).  Oracle 4: verify_plans="error" blocks the launch
+of a corrupted program with PlanVerificationError.
+"""
+import dataclasses
+import os
+
+import pytest
+
+import alpa_tpu
+from alpa_tpu import PipeshardParallel
+from alpa_tpu.analysis import plan_verifier as pv
+from alpa_tpu.global_env import global_config
+from alpa_tpu.pipeline_parallel.layer_construction import AutoLayerOption
+from alpa_tpu.pipeline_parallel.stage_construction import UniformStageOption
+from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+@pytest.fixture(autouse=True)
+def _restore_globals():
+    prev_mode = global_config.pipeline_dispatch_mode
+    prev_verify = global_config.verify_plans
+    prev_dir = global_config.compile_cache_dir
+    yield
+    global_config.pipeline_dispatch_mode = prev_mode
+    global_config.verify_plans = prev_verify
+    global_config.compile_cache_dir = prev_dir
+    from alpa_tpu.compile_cache import reset_compile_cache
+    reset_compile_cache()
+
+
+def _compile_pipeline(num_stages=2, mode="registers"):
+    alpa_tpu.init("local")
+    global_config.pipeline_dispatch_mode = mode
+    method = PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=AutoLayerOption(layer_num=4),
+        stage_option=UniformStageOption(num_stages=num_stages))
+    step = get_mlp_train_step(method, use_value_and_grad=False)
+    state, batch = create_mlp_train_state_and_batch(
+        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+        num_layers=4, manual_pipeline_layer=False)
+    state, _ = step(state, batch)
+    return step.get_last_executable(), state, batch, step
+
+
+# ---------------------------------------------------------------------
+# oracle 1: clean real program passes every analysis
+# ---------------------------------------------------------------------
+
+def test_clean_two_mesh_program_passes_all_analyses():
+    ex, *_ = _compile_pipeline(num_stages=2)
+    prog = ex._register_programs["registers"]
+    verdict = prog.verdict
+    assert verdict is not None, \
+        "verify_plans defaults to 'warn': every lowering must verify"
+    assert verdict.ok, verdict.format_table()
+    assert verdict.errors == []
+    st = verdict.stats
+    # all four analyses ran over a real program with real structure
+    assert st["n_ops"] > 0 and st["n_slots"] > 0
+    assert st["n_cross_mesh"] > 0, "2-mesh pipeline must cross meshes"
+    assert st["n_channels"] >= 1
+    assert st["num_meshes"] == 2
+    # liveness computed a nonzero static peak for every mesh
+    peaks = st["peak_bytes"]
+    assert set(peaks) == {"0", "1"}
+    assert all(b > 0 for b in peaks.values()), peaks
+    # a clean program leaks nothing (FREE emission is complete)
+    assert st["leaked_slots"] == 0, st["leaked_vars"]
+    assert "PASS" in verdict.format_table()
+
+
+def test_verify_off_skips_and_attaches_no_verdict():
+    global_config.verify_plans = "off"
+    ex, *_ = _compile_pipeline(num_stages=2)
+    assert ex._register_programs["registers"].verdict is None
+
+
+def test_verdict_surfaces_in_debug_dump(tmp_path):
+    from alpa_tpu.monitoring import dump_debug_info
+    ex, *_ = _compile_pipeline(num_stages=2)
+    dump_debug_info(ex, str(tmp_path))
+    path = tmp_path / "plan_verdict.txt"
+    assert path.exists()
+    assert "plan verdict: PASS" in path.read_text()
+
+
+# ---------------------------------------------------------------------
+# oracle 2: mutation classes, hand-built 2-mesh models
+# ---------------------------------------------------------------------
+
+_F32 = "float32"
+_AVAL = ((4, 4), _F32)
+
+
+def _slots():
+    return {
+        0: pv.SlotModel(0, "x@m0", 0, 0, (4, 4), _F32, 64,
+                        preplaced=True),
+        1: pv.SlotModel(1, "h0@m0", 0, 0, (4, 4), _F32, 64),
+        2: pv.SlotModel(2, "h0@m1", 0, 1, (4, 4), _F32, 64),
+        3: pv.SlotModel(3, "out@m1", 0, 1, (4, 4), _F32, 64,
+                        protected=True),
+    }
+
+
+def _ops():
+    return [
+        pv.OpModel(0, "RUN", 0, reads=(0,), writes=(1,),
+                   in_avals=(_AVAL,), out_avals=(_AVAL,),
+                   label="RUN stage0"),
+        pv.OpModel(1, "RESHARD", 0, reads=(1,), writes=(2,),
+                   edge=(0, 1), cross=True, nbytes=64,
+                   label="RESHARD h0 0->1"),
+        pv.OpModel(2, "RUN", 1, reads=(2,), writes=(3,),
+                   in_avals=(_AVAL,), out_avals=(_AVAL,),
+                   label="RUN stage1"),
+        pv.OpModel(3, "FREE", 0, kills=(1,), label="FREE h0@m0"),
+        pv.OpModel(4, "FREE", 1, kills=(2,), label="FREE h0@m1"),
+    ]
+
+
+def _model(ops, slots=None, streams=None, deps=None):
+    return pv.PlanModel(
+        ops=ops, slots=slots or _slots(), num_meshes=2,
+        streams=streams or [[0, 1, 3], [2, 4]],
+        deps=deps if deps is not None else {2: {1}})
+
+
+def _codes(verdict):
+    return {f.code for f in verdict.findings()}
+
+
+def test_hand_built_clean_model_passes():
+    verdict = pv.verify_model(_model(_ops()))
+    assert verdict.ok and not verdict.warnings, verdict.format_table()
+
+
+def test_mutation_swapped_recv_order_is_deadlock():
+    """The cross-mesh transfer ordered before its producer: the RECV
+    side would wait forever on a SEND that was never issued."""
+    ops = _ops()
+    # swap the RESHARD in front of the stage that produces its payload
+    ops[0], ops[1] = ops[1], ops[0]
+    ops[0] = dataclasses.replace(ops[0], idx=0)
+    ops[1] = dataclasses.replace(ops[1], idx=1)
+    verdict = pv.verify_model(_model(ops))
+    assert not verdict.ok
+    assert "deadlock.recv-before-send" in _codes(verdict), \
+        verdict.format_table()
+
+
+def test_mutation_dependency_cycle_is_deadlock():
+    """Two streams waiting on each other: Kahn's pass reports the cycle
+    with the stuck ops named."""
+    verdict = pv.verify_model(_model(_ops(), deps={2: {1}, 1: {2}}))
+    assert not verdict.ok
+    assert "deadlock.cycle" in _codes(verdict), verdict.format_table()
+
+
+def test_mutation_dtype_corrupted_run_is_typing_error():
+    ops = _ops()
+    ops[2] = dataclasses.replace(ops[2],
+                                 in_avals=(((4, 4), "bfloat16"),))
+    verdict = pv.verify_model(_model(ops))
+    assert not verdict.ok
+    assert "typing.run-input-mismatch" in _codes(verdict), \
+        verdict.format_table()
+    [finding] = [f for f in verdict.errors
+                 if f.code == "typing.run-input-mismatch"]
+    assert "h0@m1" in finding.message      # names the corrupted value
+    assert finding.op == 2
+
+
+def test_mutation_dropped_free_is_leak_with_var_names():
+    ops = _ops()[:-1]                      # drop FREE h0@m1
+    verdict = pv.verify_model(_model(ops, streams=[[0, 1, 3], [2]]))
+    assert verdict.ok                      # leak is a warning, not error
+    assert "liveness.leak" in _codes(verdict), verdict.format_table()
+    [finding] = [f for f in verdict.warnings
+                 if f.code == "liveness.leak"]
+    assert "h0@m1" in finding.message
+    assert verdict.stats["leaked_slots"] == 1
+    assert verdict.stats["leaked_vars"] == ["h0@m1"]
+
+
+def test_mutation_quantized_weight_edge_is_rejected():
+    ops = _ops()
+    ops[1] = dataclasses.replace(ops[1], strategy="quantized",
+                                 weight=True, groupable=False)
+    verdict = pv.verify_model(_model(ops))
+    assert not verdict.ok
+    assert "typing.quantized-weight-edge" in _codes(verdict), \
+        verdict.format_table()
+    [finding] = [f for f in verdict.errors
+                 if f.code == "typing.quantized-weight-edge"]
+    assert "losslessly" in finding.message
+
+
+def test_byte_mismatched_endpoints_is_deadlock():
+    slots = _slots()
+    slots[2] = dataclasses.replace(slots[2], nbytes=128)
+    verdict = pv.verify_model(_model(_ops(), slots=slots))
+    assert "deadlock.byte-mismatch" in _codes(verdict), \
+        verdict.format_table()
+
+
+def test_double_free_and_use_after_free_are_errors():
+    ops = _ops() + [pv.OpModel(5, "FREE", 1, kills=(2,),
+                               label="FREE h0@m1 again")]
+    verdict = pv.verify_model(_model(
+        ops, streams=[[0, 1, 3], [2, 4, 5]]))
+    assert "liveness.double-free" in _codes(verdict)
+
+    ops = _ops() + [pv.OpModel(5, "RUN", 1, reads=(2,), writes=(3,),
+                               label="RUN after free")]
+    verdict = pv.verify_model(_model(
+        ops, streams=[[0, 1, 3], [2, 4, 5]]))
+    assert "liveness.use-after-free" in _codes(verdict)
+
+
+# ---------------------------------------------------------------------
+# structure analysis: hooks, groups (grouped/coalesced RESHARDs)
+# ---------------------------------------------------------------------
+
+def _hook(name, node, members, reads=(), writes=(), kills=()):
+    from alpa_tpu.pipeline_parallel.runtime_emitter import OpHook
+    return OpHook(kind="exec", name=name, node=node, mesh=0,
+                  reads=tuple(reads), writes=tuple(writes),
+                  kills=tuple(kills),
+                  slots=tuple(reads) + tuple(writes) + tuple(kills),
+                  members=tuple(members))
+
+
+def test_hook_footprint_must_match_member_union():
+    ops = _ops()
+    good = _hook("RESHARD h0", 1, (1,), reads=(1,), writes=(2,))
+    verdict = pv.verify_model(_model(ops), hooks=[good])
+    assert "structure.hook-footprint" not in _codes(verdict)
+
+    bad = _hook("RESHARD h0", 1, (1,), reads=(1,), writes=())  # lost dst
+    verdict = pv.verify_model(_model(ops), hooks=[bad])
+    assert "structure.hook-footprint" in _codes(verdict), \
+        verdict.format_table()
+
+
+def test_grouped_reshard_hooks_are_member_unions():
+    """A coalesced 2-transfer group: the group hook's footprint is the
+    union of both members; collective-strategy members may not join."""
+    slots = _slots()
+    slots[4] = pv.SlotModel(4, "h1@m0", 0, 0, (4, 4), _F32, 64)
+    slots[5] = pv.SlotModel(5, "h1@m1", 0, 1, (4, 4), _F32, 64)
+    ops = [
+        pv.OpModel(0, "RUN", 0, reads=(0,), writes=(1, 4),
+                   in_avals=(_AVAL,), out_avals=(_AVAL, _AVAL),
+                   label="RUN stage0"),
+        pv.OpModel(1, "RESHARD", 0, reads=(1,), writes=(2,),
+                   edge=(0, 1), cross=True, label="RESHARD h0"),
+        pv.OpModel(2, "RESHARD", 0, reads=(4,), writes=(5,),
+                   edge=(0, 1), cross=True, label="RESHARD h1"),
+        pv.OpModel(3, "RUN", 1, reads=(2, 5), writes=(3,),
+                   in_avals=(_AVAL, _AVAL), out_avals=(_AVAL,),
+                   label="RUN stage1"),
+        pv.OpModel(4, "FREE", 0, kills=(1, 4), label="FREE m0"),
+        pv.OpModel(5, "FREE", 1, kills=(2, 5), label="FREE m1"),
+    ]
+    model = _model(ops, slots=slots, streams=[[0, 1, 2, 4], [3, 5]],
+                   deps={3: {1, 2}})
+    group = _hook("RESHARDx2", 1, (1, 2), reads=(1, 4), writes=(2, 5))
+    verdict = pv.verify_model(model, hooks=[group])
+    assert verdict.ok and not verdict.warnings, verdict.format_table()
+
+    # a collective member in a coalesced group must be rejected
+    bad_ops = list(ops)
+    bad_ops[2] = dataclasses.replace(ops[2], strategy="all_to_all",
+                                     groupable=False)
+    verdict = pv.verify_model(
+        _model(bad_ops, slots=slots, streams=[[0, 1, 2, 4], [3, 5]],
+               deps={3: {1, 2}}), hooks=[group])
+    assert "structure.group-nongroupable" in _codes(verdict), \
+        verdict.format_table()
+
+
+def test_graph_check_validates_reshard_structure():
+    """Regression for the extended InstructionDataflowGraph.check():
+    RESHARD nodes must carry a mesh edge, a consistent cross_mesh flag,
+    and a single-read/single-write footprint."""
+    from alpa_tpu.pipeline_parallel.runtime_emitter import (
+        DataflowNode, InstructionDataflowGraph)
+
+    def graph_of(node):
+        run = DataflowNode(idx=0, kind="RUN", reads=(), writes=(1,))
+        return InstructionDataflowGraph.build(
+            [run, dataclasses.replace(node, idx=1)])
+
+    ok = DataflowNode(idx=1, kind="RESHARD", reads=(1,), writes=(2,),
+                      edge=(0, 1), cross_mesh=True)
+    graph_of(ok).check()
+
+    with pytest.raises(RuntimeError, match="no mesh edge"):
+        graph_of(dataclasses.replace(ok, edge=None)).check()
+    with pytest.raises(RuntimeError, match="disagrees with edge"):
+        graph_of(dataclasses.replace(ok, cross_mesh=False)).check()
+    with pytest.raises(RuntimeError, match="exactly one"):
+        graph_of(dataclasses.replace(ok, writes=(2, 3))).check()
+
+
+# ---------------------------------------------------------------------
+# oracle 3: verdict caching — identical replay on warm restart
+# ---------------------------------------------------------------------
+
+def test_verdict_cache_replay_identical_on_warm_restart(tmp_path):
+    from alpa_tpu.compile_cache import (get_compile_cache,
+                                        reset_compile_cache)
+    global_config.compile_cache_dir = str(tmp_path)
+    reset_compile_cache()
+    ex, *_ = _compile_pipeline(num_stages=2)
+    cold = ex._register_programs["registers"].verdict
+    assert cold is not None and cold.ok
+
+    # warm restart: wipe the lowering (but not the disk cache) and the
+    # in-memory cache tier, then lower again
+    reset_compile_cache()
+    ex._register_programs = {}
+    ex._register_program = None
+    ex._ensure_lowered("registers")
+    warm = ex._register_programs["registers"].verdict
+    assert warm.to_dict() == cold.to_dict()
+    stats = get_compile_cache().stats()["namespaces"]["plan_verdict"]
+    assert stats["hits"] >= 1, stats
+
+    # verify_tool's no-recompile path reads the same verdict back
+    cached = pv.load_cached_verdicts()
+    assert cached, "no plan_verdict entries on disk"
+    assert cached[0]["verdict"].to_dict() == cold.to_dict()
+
+
+# ---------------------------------------------------------------------
+# oracle 4: verify_plans="error" blocks the launch of a broken program
+# ---------------------------------------------------------------------
+
+def test_verify_error_policy_blocks_launch():
+    """Appending a second FREE of the same keys makes the program
+    double-free; under verify_plans='error' the lowering (and therefore
+    the launch) must be refused with the named finding."""
+    from alpa_tpu.pipeline_parallel.runtime_emitter import (
+        PipelineInstType)
+    ex, state, batch, step = _compile_pipeline(num_stages=2)
+    free = next(i for i in ex.instructions
+                if i.opcode == PipelineInstType.FREE)
+    ex.instructions.append(free)
+    ex._register_programs = {}
+    ex._register_program = None
+    global_config.verify_plans = "error"
+    try:
+        with pytest.raises(pv.PlanVerificationError) as exc_info:
+            step(state, batch)
+        assert "liveness.double-free" in str(exc_info.value)
+        assert not exc_info.value.verdict.ok
+    finally:
+        # leave the executable launchable for other tests' executables
+        ex.instructions.pop()
+        ex._register_programs = {}
+        ex._register_program = None
+
+
+def test_leak_metrics_and_flight_annotation():
+    """A dropped FREE on a real program: the leak is reported on the
+    alpa_plan_leaked_slots_total counter and noted in flight dumps."""
+    from alpa_tpu.pipeline_parallel.runtime_emitter import (
+        PipelineInstType)
+    from alpa_tpu.telemetry import flight as tflight
+    ex, *_ = _compile_pipeline(num_stages=2)
+    idx = next(i for i, inst in enumerate(ex.instructions)
+               if inst.opcode == PipelineInstType.FREE)
+    dropped = ex.instructions.pop(idx)
+    ex._register_programs = {}
+    ex._register_program = None
+    tflight.clear_annotations()
+    before = pv._LEAKED_SLOTS.value
+    try:
+        prog = ex._ensure_lowered("registers")
+        verdict = prog.verdict
+        assert verdict.ok                  # warn-level finding
+        assert verdict.stats["leaked_slots"] > 0
+        assert pv._LEAKED_SLOTS.value > before
+        notes = tflight.get_annotations()
+        assert notes.get("leaked_slots") == verdict.stats["leaked_vars"]
+    finally:
+        ex.instructions.insert(idx, dropped)
+        ex._register_programs = {}
+        ex._register_program = None
+        tflight.clear_annotations()
