@@ -14,7 +14,13 @@ Two halves:
   no new legacy-timer call sites, known fault-site names), run as a
   tier-1 test (tests/util/test_repo_lint.py) and via
   ``scripts/verify_tool.py verify lint``.
+* :mod:`alpa_tpu.analysis.critical_path` — pure-data critical-path
+  walk + dependency-DAG re-simulation (ISSUE 9) under
+  :mod:`alpa_tpu.telemetry.perf`.
 """
+from alpa_tpu.analysis.critical_path import (  # noqa: F401
+    CriticalPathReport, PathStep, TimedOp, longest_path,
+    measured_critical_path, simulate_dag)
 from alpa_tpu.analysis.plan_verifier import (  # noqa: F401
     Finding, PlanModel, PlanVerdict, PlanVerificationError,
     verify_model)

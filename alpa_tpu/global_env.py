@@ -220,6 +220,14 @@ class GlobalConfig:
         # Where auto-dumps land.  None = dump_debug_info_dir, else the
         # system temp dir.
         self.flight_dump_dir = os.environ.get("ALPA_TPU_FLIGHT_DIR", None)
+        # Chip peak bf16 TFLOPS used by the MFU attribution
+        # (telemetry/perf.py — the single formula bench.py and
+        # scripts/mfu_breakdown.py also ride).  0 = auto-detect from the
+        # TPU generation via mesh_profiling.TPU_GENERATION_SPECS; set
+        # explicitly for CPU/emulated runs so stage-MFU numbers stay
+        # meaningful.
+        self.device_peak_tflops = float(os.environ.get(
+            "ALPA_TPU_DEVICE_PEAK_TFLOPS", "0"))
 
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS

@@ -181,6 +181,9 @@ def dump_debug_info(executable, dump_dir: str):
     # liveness / structure findings plus peak-live-bytes stats
     if hasattr(executable, "get_plan_verdict_text"):
         write("plan_verdict.txt", executable.get_plan_verdict_text())
+    # post-step perf analysis (ISSUE 9): critical path, bubbles, MFU
+    if hasattr(executable, "get_perf_report_text"):
+        write("perf_report.txt", executable.get_perf_report_text())
     # per-edge collective strategy decisions (ISSUE 7); also printable
     # standalone via `scripts/reshard_tool.py plan`
     from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (

@@ -1310,6 +1310,45 @@ class PipeshardDriverExecutable:
                     "lowering failed, or launch not register-eligible)")
         return verdict.format_table()
 
+    def get_perf_report(self):
+        """Post-step :class:`~alpa_tpu.telemetry.perf.StepPerfReport`
+        (ISSUE 9): critical path, per-mesh bubbles, transfer overlap,
+        stage MFU — joined from the last launch's trace spans (or the
+        flight ring when full tracing is off) against the lowered
+        program's dataflow graph.  Publishes the ``alpa_stage_mfu``/
+        ``alpa_step_bubble_fraction``/``alpa_critical_path_us`` gauges.
+        None when no step has been recorded."""
+        from alpa_tpu.telemetry import perf as _perf
+        stats = getattr(self, "last_dispatch_stats", None) or {}
+        mode = stats.get("mode")
+        prog = self._register_programs.get(mode) if mode else None
+        joined = _perf.joined_from_recorder(_ttrace.get_recorder(), prog)
+        if joined is None and _flight.enabled():
+            joined = _perf.joined_from_flight(
+                _flight.get_recorder().snapshot(), prog)
+        if joined is None:
+            return None
+        report = _perf.build_step_report(
+            joined, program=prog, schedule=self.schedule,
+            stage_execs=(self.stage_execs +
+                         [e for e in self.apply_execs if e is not None]),
+            mode=mode, run_stats=stats)
+        _perf.publish_report(report)
+        return report
+
+    def get_perf_report_text(self) -> str:
+        """``perf_report.txt`` content for dump_debug_info."""
+        report = None
+        try:
+            report = self.get_perf_report()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("get_perf_report_text failed")
+        if report is None:
+            return ("perf report: (not available — no step recorded; "
+                    "enable tracing via ALPA_TPU_TRACE=1 or the flight "
+                    "ring via ALPA_TPU_FLIGHT=1 and run a step)")
+        return report.format_text()
+
     def get_plan_fingerprint(self) -> str:
         """Content hash of the compiled parallel plan: instruction stream
         plus every stage's input/output shardings.  Two executables with

@@ -966,24 +966,41 @@ def _inflight_delta(d: int):
     _ttrace.counter("transfers_in_flight", v)
 
 
+def _record_pool_spans(label, t_sub_us, t_run_us, t_end_us):
+    """Pool-side span family for one transfer: the labeled parent covers
+    submit→retire, with ``reshard.wait`` (queue time between the driver
+    submit and the worker picking it up — scheduler backpressure, not
+    network) and ``reshard.wire`` (actual transfer execution) children.
+    All timestamps come from ``trace.now_us`` so the driver-side submit
+    stamp and the worker-side stamps share one epoch; insertion order
+    parent-first keeps B-tie nesting correct in the Chrome export."""
+    rec = _ttrace.get_recorder()
+    rec.complete(label, "transfer", t_sub_us, t_end_us - t_sub_us)
+    rec.complete("reshard.wait", "transfer", t_sub_us,
+                 max(0.0, t_run_us - t_sub_us))
+    rec.complete("reshard.wire", "transfer", t_run_us,
+                 max(0.0, t_end_us - t_run_us))
+
+
 def _make_launch_op(transfer, src_slot, dst_slot, label="transfer"):
     # regs[src] is captured on the driver thread at launch time, so a
     # later donation/FREE of the src slot (which the schedule orders
     # after this launch's wait anyway) can never race the worker.
     def op(regs, _t=transfer, _s=src_slot, _d=dst_slot, _l=label):
         v = regs[_s]
+        traced = _ttrace.enabled()
+        t_sub = _ttrace.now_us() if traced else 0.0
 
-        def work(_v=v, _tt=_t, _ll=_l):
-            # pool-side launch→retire span on the worker thread's track
-            tok = _ttrace.begin(_ll, "transfer") if _ttrace.enabled() \
-                else None
+        def work(_v=v, _tt=_t, _ll=_l, _traced=traced, _sub=t_sub):
+            t_run = _ttrace.now_us() if _traced else 0.0
             t0 = time.perf_counter()
             out = _tt(_v)
             busy = time.perf_counter() - t0
-            _ttrace.end(tok)
+            if _traced:
+                _record_pool_spans(_ll, _sub, t_run, _ttrace.now_us())
             return out, busy
 
-        if _ttrace.enabled():
+        if traced:
             _inflight_delta(1)
         regs[_d] = _PendingTransfer(_transfer_pool().submit(work))
 
@@ -1011,17 +1028,19 @@ def _make_launch_group_op(group, src_slots, dst_slots,
     # member's dst slot; the group wait scatters every output.
     def op(regs, _g=group, _s=src_slots, _d=dst_slots, _l=label):
         vals = [regs[s] for s in _s]
+        traced = _ttrace.enabled()
+        t_sub = _ttrace.now_us() if traced else 0.0
 
-        def work(_v=vals, _gg=_g, _ll=_l):
-            tok = _ttrace.begin(_ll, "transfer") if _ttrace.enabled() \
-                else None
+        def work(_v=vals, _gg=_g, _ll=_l, _traced=traced, _sub=t_sub):
+            t_run = _ttrace.now_us() if _traced else 0.0
             t0 = time.perf_counter()
             outs = _gg(_v)
             busy = time.perf_counter() - t0
-            _ttrace.end(tok)
+            if _traced:
+                _record_pool_spans(_ll, _sub, t_run, _ttrace.now_us())
             return outs, busy
 
-        if _ttrace.enabled():
+        if traced:
             _inflight_delta(1)
         regs[_d[0]] = _PendingTransfer(_transfer_pool().submit(work))
 

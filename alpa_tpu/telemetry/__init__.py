@@ -10,6 +10,10 @@ Two pillars:
 * :mod:`alpa_tpu.telemetry.flight` — always-on flight recorder (ISSUE
   6): fixed-size lock-free ring of the last N instruction events,
   auto-dumped on step failure / fault fire / SUSPECT transition.
+* :mod:`alpa_tpu.telemetry.perf` — post-step analysis (ISSUE 9):
+  critical path, pipeline-bubble and MFU attribution over the recorded
+  spans, published as ``alpa_stage_mfu``/``alpa_step_bubble_fraction``/
+  ``alpa_critical_path_us``.
 
 See docs/observability.md for the span model, category taxonomy and
 knob table (``ALPA_TPU_TRACE`` / ``ALPA_TPU_TRACE_DIR`` /
@@ -23,6 +27,9 @@ from alpa_tpu.telemetry.trace import (         # noqa: F401
     get_recorder, instant, merge_chrome_traces, set_enabled,
     set_recorder, span)
 from alpa_tpu.telemetry.flight import FlightRecorder  # noqa: F401
+from alpa_tpu.telemetry.perf import (          # noqa: F401
+    StepPerfReport, build_step_report, compute_mfu, device_peak_tflops,
+    mfu_from_time, report_from_trace, stage_flops)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -30,4 +37,7 @@ __all__ = [
     "CATEGORIES", "TraceRecorder", "begin", "counter", "enabled",
     "end", "get_recorder", "instant", "merge_chrome_traces",
     "set_enabled", "set_recorder", "span", "FlightRecorder",
+    "StepPerfReport", "build_step_report", "compute_mfu",
+    "device_peak_tflops", "mfu_from_time", "report_from_trace",
+    "stage_flops",
 ]

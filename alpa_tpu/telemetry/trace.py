@@ -32,7 +32,7 @@ from alpa_tpu.global_env import global_config
 __all__ = [
     "TraceRecorder", "get_recorder", "set_recorder", "enabled",
     "set_enabled", "span", "instant", "counter", "begin", "end",
-    "merge_chrome_traces", "CATEGORIES",
+    "now_us", "merge_chrome_traces", "CATEGORIES",
 ]
 
 # category taxonomy (docs/observability.md) — free-form strings are
@@ -47,6 +47,12 @@ _EPOCH = time.perf_counter()
 
 def _now_us() -> float:
     return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def now_us() -> float:
+    """Current time on the recorder's shared epoch — pair with
+    :meth:`TraceRecorder.complete` for externally-timed spans."""
+    return _now_us()
 
 
 class _NullSpan:
@@ -149,6 +155,19 @@ class TraceRecorder:
     def end(self, token: Optional[_Span]):
         if token is not None and token is not _NULL_SPAN:
             self._finish(token)
+
+    def complete(self, name: str, category: str, ts_us: float,
+                 dur_us: float, args: Optional[Dict[str, Any]] = None,
+                 track: Optional[str] = None):
+        """Record an already-timed span — async work whose start was
+        stamped on another thread (e.g. the overlap pool's queue-wait
+        child, whose begin is the driver-side submit).  ``ts_us`` must
+        come from :func:`now_us` so it shares the process epoch."""
+        tid = self._tid(track)
+        with self._lock:
+            if self._room(self._spans):
+                self._spans.append((name, category, ts_us, dur_us, tid,
+                                    args))
 
     def instant(self, name: str, category: str = "runtime",
                 args: Optional[Dict[str, Any]] = None,

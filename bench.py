@@ -359,12 +359,15 @@ def main():
     # comparability with the reference recipe, which flatters a TPU).
     mfu = None
     if on_tpu:
-        from alpa_tpu.mesh_profiling import (TPU_GENERATION_SPECS,
-                                             detect_tpu_generation)
-        gen = detect_tpu_generation()
-        peak = TPU_GENERATION_SPECS[gen]["peak_bf16_tflops"]
-        mfu = {"generation": gen, "peak_bf16_tflops": peak,
-               "mfu": round(tflops / peak, 4)}
+        # the one MFU formula (ISSUE 9): telemetry.perf resolves the
+        # peak from the device_peak_tflops knob or the detected
+        # generation's TPU_GENERATION_SPECS entry
+        from alpa_tpu.telemetry.perf import compute_mfu, peak_flops_info
+        info = peak_flops_info()
+        mfu = {"generation": info["generation"],
+               "peak_bf16_tflops": info["peak_bf16_tflops"],
+               "mfu": round(compute_mfu(tflops,
+                                        info["peak_bf16_tflops"]), 4)}
     result = {
         "metric": "gpt_train_tflops_per_chip",
         "value": round(tflops, 3),

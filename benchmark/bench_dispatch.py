@@ -45,9 +45,13 @@ and hooked-executor overhead sections.
 Usage::
 
     python benchmark/bench_dispatch.py [--steps N] [--out FILE] [--trace]
+                                       [--gate]
 
 ``--trace`` additionally saves the tracing-on run's merged Chrome trace
 to ``benchmark/results/dispatch_trace.json`` (Perfetto-loadable).
+``--gate`` checks the fresh numbers against the committed
+``benchmark/results/perf_gate_baseline.json`` tolerances
+(benchmark/perf_gate.py, ISSUE 9) and exits non-zero on regression.
 """
 import argparse
 import json
@@ -357,6 +361,9 @@ def main():
     parser.add_argument("--trace", action="store_true",
                         help="save the tracing-on run's Chrome trace to "
                              "benchmark/results/dispatch_trace.json")
+    parser.add_argument("--gate", action="store_true",
+                        help="check results against the committed "
+                             "perf_gate baseline; exit 1 on regression")
     args = parser.parse_args()
 
     from alpa_tpu.platform import pin_cpu_platform
@@ -375,6 +382,12 @@ def main():
     with open(args.out, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
+    if args.gate:
+        from benchmark.perf_gate import flatten_metrics, gate
+        verdict = gate(flatten_metrics(report))
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

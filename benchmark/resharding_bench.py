@@ -44,7 +44,11 @@ Usage:
       [--json benchmark/results/resharding_overlap.json]
       [--collectives-json benchmark/results/resharding_collectives.json]
       [--strategy sweep|<name>] [--quantize sweep|int8|fp8|off]
-      [--skip-overlap] [--skip-strategy]
+      [--skip-overlap] [--skip-strategy] [--gate]
+
+``--gate`` checks the overlap sweep against the committed
+``benchmark/results/perf_gate_baseline.json`` tolerances
+(benchmark/perf_gate.py, ISSUE 9) and exits non-zero on regression.
 """
 import argparse
 import json
@@ -319,6 +323,10 @@ def main():
                         help="codec sweep: both codecs, one, or off")
     parser.add_argument("--skip-strategy", action="store_true",
                         help="skip the ISSUE 7 collective sweeps")
+    parser.add_argument("--gate", action="store_true",
+                        help="check the overlap sweep against the "
+                             "committed perf_gate baseline; exit 1 on "
+                             "regression")
     args = parser.parse_args()
 
     if os.environ.get("JAX_PLATFORMS") != "tpu":
@@ -413,6 +421,12 @@ def main():
     with open(args.json, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
     print(json.dumps(report, indent=1))
+    if args.gate:
+        from benchmark.perf_gate import flatten_metrics, gate
+        verdict = gate(flatten_metrics(report))
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit(1)
 
     # -- ISSUE 7 sweeps -> resharding_collectives.json ----------------
     if args.skip_strategy or args.strategy == "off":

@@ -10,16 +10,20 @@ differences attribute step time to forward / backward / optimizer /
 logits+CE, and the pure-matmul ceiling separates "XLA didn't reach
 peak on these shapes" from "the model adds overhead".
 
-Writes benchmark/results/mfu_breakdown.json.
+Writes benchmark/results/mfu_breakdown.json.  The peak-FLOPs framing
+and the leg attribution math live in scripts/perf_tool.py /
+alpa_tpu.telemetry.perf (ISSUE 9: one MFU formula) — this script only
+runs the timed legs.
 """
 import json
 import os
 import subprocess
 import sys
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+from scripts.perf_tool import attribute_legs, mfu_summary  # noqa: E402
 
 _CHILD = r'''
 import json, sys, time
@@ -141,10 +145,15 @@ def main():
     def flush(attribution=None):
         """Write after EVERY leg: an outer timeout (runbook) or wedge
         mid-run must not discard completed legs."""
+        peak = mfu_summary(0.0)
         report = {"config": "h2048-l16 bs8 seq1024 bf16 (official "
                             "bench)",
-                  "peak_bf16_tflops_v5e": 197.0,
+                  "generation": peak["generation"],
+                  "peak_bf16_tflops": peak["peak_bf16_tflops"],
                   "legs": results, "attribution": attribution or {}}
+        tfl = results.get("train_step", {}).get("tflops_per_chip")
+        if tfl is not None:
+            report["mfu"] = mfu_summary(tfl)["mfu"]
         os.makedirs(os.path.dirname(out_path), exist_ok=True)
         with open(out_path, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1)
@@ -174,22 +183,8 @@ def main():
             break
         flush()
 
-    # subtraction-based attribution (seconds)
-    def s(leg):
-        return results.get(leg, {}).get("s")
-
-    full, fb, fwd, fh = (s("train_step"), s("fwd_bwd"), s("forward"),
-                         s("forward_hidden"))
-    attribution = {}
-    if all(v is not None for v in (full, fb, fwd, fh)):
-        attribution = {
-            "forward_body_s": round(fh, 4),
-            "lm_head_ce_s": round(fwd - fh, 4),
-            "backward_s": round(fb - fwd, 4),
-            "optimizer_s": round(full - fb, 4),
-            "total_s": round(full, 4),
-        }
-    report = flush(attribution)
+    # subtraction-based attribution (seconds) — shared with perf_tool
+    report = flush(attribute_legs(results))
     print(json.dumps(report))
     return 0
 

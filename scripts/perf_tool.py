@@ -1,0 +1,197 @@
+"""Analyze saved telemetry traces with the step perf engine
+(alpa_tpu.telemetry.perf, ISSUE 9).
+
+Usage::
+
+    python scripts/perf_tool.py analyze      TRACE.json [--json] [--top N]
+    python scripts/perf_tool.py critical-path TRACE.json [--top K]
+    python scripts/perf_tool.py whatif       TRACE.json [--zero reshard]
+                                             [--name SUBSTR]
+    python scripts/perf_tool.py compare      A.json B.json
+
+``analyze`` prints the full :class:`StepPerfReport` (critical path,
+per-mesh bubble fractions, transfer overlap, stage MFU where RUN spans
+carry stage names) for the last ``pipeshard.step`` envelope in the
+trace; ``critical-path`` prints just the path table; ``whatif``
+re-simulates the step with an op class made free ("if this RESHARD were
+free, step −X%"); ``compare`` diffs two analyzed traces metric by
+metric (the interactive sibling of ``benchmark/perf_gate.py``, which
+does the same against committed baselines with tolerances).
+
+Traces come from ``scripts/trace_tool.py record``, from
+``ALPA_TPU_TRACE_DIR`` auto-saves, or from ``dump_debug_info``'s
+``trace.json``.  Offline analysis has no lowered program to join
+against, so dependencies are per-track order (the report says so);
+in-process callers get the dataflow-graph join via
+``PipeshardDriverExecutable.get_perf_report()``.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.telemetry import perf as _perf  # noqa: E402
+
+
+def _load(path):
+    with open(path, encoding="utf-8") as f:
+        trace = json.load(f)
+    if "traceEvents" not in trace:
+        sys.exit(f"{path}: not a chrome trace (no traceEvents)")
+    return trace
+
+
+def _report(path):
+    report = _perf.report_from_trace(_load(path))
+    if report is None:
+        sys.exit(f"{path}: no analyzable step (no mesh-track "
+                 f"instruction/transfer spans)")
+    return report
+
+
+def mfu_summary(tflops_per_chip):
+    """Shared MFU framing for bench tooling (scripts/mfu_breakdown.py,
+    bench.py): achieved TFLOPS/chip against the one peak-FLOPs source
+    (``telemetry.perf`` — the ``device_peak_tflops`` knob or the
+    detected generation's bf16 peak)."""
+    info = _perf.peak_flops_info()
+    return {
+        "generation": info["generation"],
+        "peak_bf16_tflops": info["peak_bf16_tflops"],
+        "mfu": round(_perf.compute_mfu(tflops_per_chip,
+                                       info["peak_bf16_tflops"]), 4),
+    }
+
+
+def attribute_legs(results):
+    """Subtraction-based step-time attribution over mfu_breakdown's
+    timed legs (forward / lm-head+CE / backward / optimizer)."""
+    def s(leg):
+        return results.get(leg, {}).get("s")
+
+    full, fb, fwd, fh = (s("train_step"), s("fwd_bwd"), s("forward"),
+                         s("forward_hidden"))
+    if any(v is None for v in (full, fb, fwd, fh)):
+        return {}
+    return {
+        "forward_body_s": round(fh, 4),
+        "lm_head_ce_s": round(fwd - fh, 4),
+        "backward_s": round(fb - fwd, 4),
+        "optimizer_s": round(full - fb, 4),
+        "total_s": round(full, 4),
+    }
+
+
+def cmd_analyze(args):
+    report = _report(args.trace)
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.format_text(top=args.top))
+
+
+def cmd_critical_path(args):
+    report = _report(args.trace)
+    print(report.critical_path.format_table(top=args.top))
+    by_kind = report.critical_path.by_kind()
+    if by_kind:
+        parts = ", ".join(f"{k} {us:.1f} us"
+                          for k, us in sorted(by_kind.items()))
+        print(f"path op time by kind: {parts}")
+
+
+def cmd_whatif(args):
+    report = _report(args.trace)
+    verdict = report.whatif(args.zero, name_substr=args.name)
+    print(json.dumps(verdict, indent=1))
+    what = verdict["zero"]
+    print(f"if every {what} op were free: step "
+          f"{verdict['baseline_us']:.1f} us -> "
+          f"{verdict['whatif_us']:.1f} us "
+          f"(-{100.0 * verdict['saving_fraction']:.1f}%, "
+          f"{verdict['n_zeroed']} ops zeroed)", file=sys.stderr)
+
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}.{k}" if prefix else k
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[key] = float(v)
+    return out
+
+
+def _metrics_from(path):
+    """Flattened metrics from a chrome trace, an ``analyze --json``
+    report dict, or a perf_gate baseline file."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if "traceEvents" in data:
+        return _flatten(_report(path).to_dict())
+    if "metrics" in data:            # perf_gate baseline format
+        return {k: v["value"] for k, v in data["metrics"].items()
+                if isinstance(v, dict) and "value" in v}
+    return _flatten(data)
+
+
+def cmd_compare(args):
+    a = _metrics_from(args.a)
+    b = _metrics_from(args.b)
+    keys = sorted(set(a) & set(b))
+    print(f"{'metric':<48} {'a':>12} {'b':>12} {'ratio':>8}")
+    for k in keys:
+        ratio = b[k] / a[k] if a[k] else float("inf") if b[k] else 1.0
+        flag = "  <--" if ratio > 1.25 or ratio < 0.8 else ""
+        print(f"{k:<48} {a[k]:>12.4f} {b[k]:>12.4f} "
+              f"{ratio:>8.3f}{flag}")
+    only_a = sorted(set(a) - set(b))
+    only_b = sorted(set(b) - set(a))
+    if only_a:
+        print(f"only in {args.a}: {', '.join(only_a)}")
+    if only_b:
+        print(f"only in {args.b}: {', '.join(only_b)}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pa = sub.add_parser("analyze", help="full step perf report")
+    pa.add_argument("trace")
+    pa.add_argument("--json", action="store_true",
+                    help="machine-readable report dict")
+    pa.add_argument("--top", type=int, default=10)
+    pa.set_defaults(func=cmd_analyze)
+
+    pc = sub.add_parser("critical-path",
+                        help="just the measured critical path")
+    pc.add_argument("trace")
+    pc.add_argument("--top", type=int, default=10)
+    pc.set_defaults(func=cmd_critical_path)
+
+    pw = sub.add_parser("whatif",
+                        help="re-simulate with an op class made free")
+    pw.add_argument("trace")
+    pw.add_argument("--zero", default="reshard",
+                    choices=("reshard", "transfer", "run", "free"))
+    pw.add_argument("--name", default=None,
+                    help="zero ops whose name contains SUBSTR instead")
+    pw.set_defaults(func=cmd_whatif)
+
+    pp = sub.add_parser("compare",
+                        help="diff two analyzed traces metric by metric")
+    pp.add_argument("a")
+    pp.add_argument("b")
+    pp.set_defaults(func=cmd_compare)
+
+    args = p.parse_args(argv)
+    args.func(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -4,7 +4,7 @@ Usage::
 
     python scripts/trace_tool.py record  OUT.json -- CMD [ARGS...]
     python scripts/trace_tool.py merge   OUT.json TRACE.json [TRACE.json...]
-    python scripts/trace_tool.py summarize TRACE.json [--top N]
+    python scripts/trace_tool.py summarize TRACE.json [--top N] [--bubbles]
     python scripts/trace_tool.py top     TRACE.json [--top N]
     python scripts/trace_tool.py flight  FLIGHT.json [--last N]
 
@@ -12,9 +12,12 @@ Usage::
 ``ALPA_TPU_TRACE_DIR`` pointed at a scratch dir, then merges whatever
 trace files the run saved into OUT.json; ``merge`` combines per-mesh /
 per-process trace files onto distinct pids (each input keeps its own
-track group in Perfetto); ``summarize`` prints total time per category
-plus the longest individual spans; ``top`` aggregates spans by name
-(hottest instructions first).  All outputs load directly in
+track group in Perfetto); ``summarize`` prints total time per category,
+per-track busy/idle/span-count columns, and the longest individual
+spans — ``--bubbles`` additionally runs the step perf analyzer
+(``alpa_tpu.telemetry.perf`` / ``scripts/perf_tool.py``, ISSUE 9) for
+per-mesh bubble fractions; ``top`` aggregates spans by name (hottest
+instructions first).  All outputs load directly in
 https://ui.perfetto.dev.
 
 ``flight`` pretty-prints a flight-recorder dump (ISSUE 6): the ring of
@@ -112,9 +115,35 @@ def cmd_summarize(args):
     total = sum(per_cat.values()) or 1.0
     for cat, us in per_cat.most_common():
         print(f"{cat:<16} {us / 1e3:>12.3f} {us / total:>6.1%}")
+    # per-track busy/idle against the trace's overall envelope (ISSUE 9)
+    from alpa_tpu.telemetry.perf import spans_from_chrome
+    tracked = spans_from_chrome(trace)
+    if tracked:
+        t0 = min(s["ts_us"] for s in tracked)
+        t1 = max(s["ts_us"] + s["dur_us"] for s in tracked)
+        envelope = max(t1 - t0, 1e-9)
+        per_track = collections.defaultdict(lambda: [0, 0.0])
+        for s in tracked:
+            per_track[s["track"]][0] += 1
+            per_track[s["track"]][1] += s["dur_us"]
+        print(f"\n{'track':<20} {'spans':>7} {'busy ms':>12} "
+              f"{'idle ms':>12} {'busy':>7}")
+        for track, (n, busy) in sorted(per_track.items(),
+                                       key=lambda kv: -kv[1][1]):
+            idle = max(0.0, envelope - busy)
+            print(f"{track:<20} {n:>7} {busy / 1e3:>12.3f} "
+                  f"{idle / 1e3:>12.3f} {busy / envelope:>6.1%}")
     print(f"\ntop {args.top} longest spans:")
     for name, cat, dur in sorted(spans, key=lambda s: -s[2])[:args.top]:
         print(f"  {dur / 1e3:>10.3f} ms  [{cat}] {name}")
+    if args.bubbles:
+        from alpa_tpu.telemetry.perf import report_from_trace
+        report = report_from_trace(trace)
+        if report is None:
+            print("\n--bubbles: no analyzable step (no mesh-track "
+                  "instruction/transfer spans)")
+        else:
+            print(f"\n{report.format_text(top=args.top)}")
 
 
 def cmd_top(args):
@@ -196,6 +225,9 @@ def main(argv=None):
     ps = sub.add_parser("summarize", help="per-category totals + top spans")
     ps.add_argument("trace")
     ps.add_argument("--top", type=int, default=10)
+    ps.add_argument("--bubbles", action="store_true",
+                    help="run the step perf analyzer (per-mesh bubble "
+                         "fractions, critical path)")
     ps.set_defaults(func=cmd_summarize)
 
     pt = sub.add_parser("top", help="hottest span names")
