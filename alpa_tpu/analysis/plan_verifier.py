@@ -64,13 +64,26 @@ __all__ = [
 ANALYSES = ("typing", "deadlock", "liveness", "structure")
 
 #: bump when an analysis changes meaning — invalidates cached verdicts
-ANALYSES_VERSION = 1
+#: (v2: launch-placed slots are accounted at per-device bytes derived
+#: from their static sharding, so ZeRO-sharded optimizer state shows
+#: the ~dp× reduction in ``peak_bytes``)
+ANALYSES_VERSION = 2
 
 _REG = _tmetrics.get_registry()
 _PEAK_BYTES = _REG.gauge(
     "alpa_plan_peak_bytes",
     "Static peak live register-file bytes per mesh (plan verifier)",
     labelnames=("mesh",))
+_OPT_STATE_BYTES = _REG.gauge(
+    "alpa_opt_state_bytes",
+    "Static per-device optimizer-state bytes resident per mesh "
+    "(plan verifier; shrinks ~dp_size x under ZeRO weight-update "
+    "sharding)",
+    labelnames=("mesh",))
+_ZERO_SAVED = _REG.gauge(
+    "alpa_zero_bytes_saved_total",
+    "Bytes the verified plan's sharded weight-update layout saves per "
+    "device versus replicated leaves, summed over meshes")
 _LEAKED_SLOTS = _REG.counter(
     "alpa_plan_leaked_slots_total",
     "Slots the plan verifier found produced but never freed")
@@ -116,9 +129,12 @@ class SlotModel:
     mesh: int
     shape: Tuple[int, ...] = ()
     dtype: str = ""
-    nbytes: int = 0
+    nbytes: int = 0             # per-device bytes (sharding-aware when
+                                # the driver placed the slot at launch)
+    full_nbytes: int = 0        # unsharded (global) bytes of the value
     preplaced: bool = False     # placed by the driver at launch
     protected: bool = False     # program output — never freed by design
+    opt_state: bool = False     # optimizer-state leaf (ZeRO target)
 
 
 @dataclasses.dataclass
@@ -216,6 +232,18 @@ class PlanVerdict:
                     f"mesh {m}: {b / 2 ** 20:.2f} MiB"
                     for m, b in sorted(peaks.items(),
                                        key=lambda kv: str(kv[0]))))
+            opt = st.get("opt_state_bytes", {})
+            if any(opt.values()):
+                lines.append("opt-state bytes/device: " + "  ".join(
+                    f"mesh {m}: {b / 2 ** 20:.2f} MiB"
+                    for m, b in sorted(opt.items(),
+                                       key=lambda kv: str(kv[0]))))
+                saved = st.get("zero_bytes_saved", 0.0)
+                if saved:
+                    lines.append(
+                        f"zero sharding saves "
+                        f"{saved / 2 ** 20:.2f} MiB/device vs "
+                        f"replicated")
             leaked = st.get("leaked_vars", ())
             if leaked:
                 lines.append(
@@ -257,12 +285,26 @@ def _strategy_of(transfer) -> str:
     return getattr(transfer, "strategy", None) or "direct_p2p"
 
 
+def _per_device_nbytes(sharding, shape: Tuple[int, ...],
+                       dtype: str, nbytes: int) -> int:
+    """Per-device bytes of a value under ``sharding`` (falls back to
+    the global size for replicated / unknown layouts)."""
+    try:
+        import numpy as np
+        shard = sharding.shard_shape(tuple(shape))
+        n = int(np.prod(shard, dtype=np.int64)) if shard else 1
+        return n * int(np.dtype(dtype).itemsize)
+    except Exception:  # pylint: disable=broad-except
+        return nbytes
+
+
 def build_model(instructions: Sequence[Any],
                 slot_of: Dict[Tuple[Any, int, int], int],
                 preplaced_shardings: Dict[Tuple[Any, int, int], Any],
                 recs: Sequence[Dict[str, Any]],
                 protected_keys=frozenset(),
-                mode: str = "registers") -> PlanModel:
+                mode: str = "registers",
+                opt_state_keys=frozenset()) -> PlanModel:
     """Assemble a :class:`PlanModel` from the lowering's inputs: the
     emitted instruction list, the slot table, the launch-placed keys,
     and the phase-1 per-instruction records (kind / footprint / edge /
@@ -273,11 +315,22 @@ def build_model(instructions: Sequence[Any],
     slots: Dict[int, SlotModel] = {}
     for (var, inst_id, mesh), s in slot_of.items():
         shape, dtype, nbytes = _aval_of(var)
+        key = (var, inst_id, mesh)
+        preplaced = key in preplaced_shardings
+        per_dev = nbytes
+        if preplaced and shape and dtype:
+            # launch-placed slots carry a static sharding — account
+            # them at per-device bytes so ZeRO-sharded optimizer state
+            # proves its ~dp_size x reduction in peak_bytes
+            per_dev = _per_device_nbytes(
+                preplaced_shardings[key], shape, dtype, nbytes)
         slots[s] = SlotModel(
             slot=s, var=str(var), instance=inst_id, mesh=mesh,
-            shape=shape, dtype=dtype, nbytes=nbytes,
-            preplaced=(var, inst_id, mesh) in preplaced_shardings,
-            protected=(var, inst_id, mesh) in protected_keys)
+            shape=shape, dtype=dtype, nbytes=per_dev,
+            full_nbytes=nbytes,
+            preplaced=preplaced,
+            protected=key in protected_keys,
+            opt_state=key in opt_state_keys)
 
     num_meshes = 1
     for inst in instructions:
@@ -645,9 +698,24 @@ def check_liveness(model: PlanModel
                     f"{peak:.0f} exceed the device memory limit "
                     f"{model.device_memory_bytes:.0f}"))
 
+    # per-mesh resident optimizer-state bytes (launch-placed slots live
+    # for the whole step) and the per-device bytes the plan's sharded
+    # weight-update layout saves versus replicated leaves
+    opt_bytes = [0.0] * model.num_meshes
+    zero_saved = 0.0
+    for s, sm in model.slots.items():
+        if not sm.opt_state:
+            continue
+        opt_bytes[_mesh(s)] += sm.nbytes
+        if sm.full_nbytes > sm.nbytes:
+            zero_saved += sm.full_nbytes - sm.nbytes
+
     stats = {
         "peak_bytes": {str(m): peak_bytes[m]
                        for m in range(model.num_meshes)},
+        "opt_state_bytes": {str(m): opt_bytes[m]
+                            for m in range(model.num_meshes)},
+        "zero_bytes_saved": zero_saved,
         "leaked_slots": len(leaked),
         "leaked_vars": leaked_vars,
     }
@@ -794,7 +862,8 @@ def verify_program(instructions: Sequence[Any],
                    prog,
                    preplaced_shardings: Dict[Any, Any],
                    recs: Sequence[Dict[str, Any]],
-                   protected_keys=frozenset()) -> PlanVerdict:
+                   protected_keys=frozenset(),
+                   opt_state_keys=frozenset()) -> PlanVerdict:
     """Compile-time entry point, called by ``lower_to_register_file``
     for every lowered program when ``global_config.verify_plans`` is
     not ``"off"``.
@@ -821,7 +890,8 @@ def verify_program(instructions: Sequence[Any],
         model = build_model(instructions, prog.slot_of,
                             preplaced_shardings, recs,
                             protected_keys=protected_keys,
-                            mode=prog.mode)
+                            mode=prog.mode,
+                            opt_state_keys=opt_state_keys)
         verdict = verify_model(model, hooks=prog.hooks)
         if cache is not None:
             cache.put("plan_verdict", key, verdict.to_dict())
@@ -829,6 +899,9 @@ def verify_program(instructions: Sequence[Any],
     # metrics + flight annotation (process-global observability)
     for m, b in verdict.stats.get("peak_bytes", {}).items():
         _PEAK_BYTES.labels(str(m)).set(b)
+    for m, b in verdict.stats.get("opt_state_bytes", {}).items():
+        _OPT_STATE_BYTES.labels(str(m)).set(b)
+    _ZERO_SAVED.set(float(verdict.stats.get("zero_bytes_saved", 0.0)))
     leaked = verdict.stats.get("leaked_vars", ())
     if leaked:
         _LEAKED_SLOTS.inc(verdict.stats.get("leaked_slots",

@@ -45,6 +45,12 @@ class GlobalConfig:
         self.ilp_time_limit = int(os.environ.get("ALPA_TPU_ILP_TIME_LIMIT", "600"))
         # Seed used for deterministic compilation decisions.
         self.compile_seed = int(os.environ.get("ALPA_TPU_COMPILE_SEED", "42"))
+        # Weight-update (ZeRO) sharding stage: "auto" lets the ILP choose
+        # sharded optimizer state by cost (memory term vs all-gather
+        # traffic), "0" disables it, "2" shards optimizer state over the
+        # data-parallel axis, "3" also shards parameters.  Seeds
+        # AutoShardingOption.zero_stage.
+        self.zero_stage = os.environ.get("ALPA_TPU_ZERO_STAGE", "auto")
 
         # ---------- runtime ----------
         # Cross-mesh resharding strategy: "send_recv" | "broadcast".

@@ -507,8 +507,14 @@ def estimate_stage_cost(stage_comps,
     return compute_cost + comm_cost
 
 
+#: optimizer-state bytes per parameter byte (Adam-family: mu + nu)
+OPT_STATE_MULT = 2.0
+
+
 def estimate_stage_memory_split(stage_comps,
-                                logical_mesh: LogicalDeviceMesh
+                                logical_mesh: LogicalDeviceMesh,
+                                as_option=None,
+                                objective: str = "training"
                                 ) -> Tuple[float, float]:
     """(per-device param bytes, per-device per-microbatch activation
     bytes).
@@ -522,6 +528,15 @@ def estimate_stage_memory_split(stage_comps,
     forwarded across layer slices) are excluded, and duplicates across the
     stage's layer comps count once.  Both terms divide by the submesh size:
     the intra-op planner shards parameters AND activations across it.
+
+    When ``as_option`` is given and ``objective == "training"``, the
+    param term also carries the stage's optimizer state
+    (:data:`OPT_STATE_MULT` x param bytes, Adam-family): replicated
+    per device under ``zero_stage=0``, divided by the submesh size
+    under ZeRO weight-update sharding (``zero_stage`` 2/3 — and
+    ``auto``, because the memory-budgeted ILP resolves auto to sharded
+    exactly when this budget matters).  That makes the ZeRO saving
+    visible to the stage DP, so stage boundaries can shift.
     """
     produced = {id(v) for c in stage_comps for v in c.outvars}
     param_bytes = 0.0
@@ -545,13 +560,22 @@ def estimate_stage_memory_split(stage_comps,
             act_bytes += float(np.prod(v.aval.shape) or 1) * \
                 v.aval.dtype.itemsize
     n = max(logical_mesh.num_devices, 1)
-    return param_bytes / n, act_bytes / n
+    opt_bytes = 0.0
+    if as_option is not None and objective == "training":
+        from alpa_tpu.shard_parallel.auto_sharding import (
+            resolved_zero_stage)
+        zero = resolved_zero_stage(as_option)
+        opt_bytes = OPT_STATE_MULT * param_bytes
+        if zero != 0:
+            opt_bytes /= n
+    return param_bytes / n + opt_bytes, act_bytes / n
 
 
 def estimate_stage_memory(stage_comps, logical_mesh: LogicalDeviceMesh,
-                          num_in_flight: int = 1) -> float:
+                          num_in_flight: int = 1, as_option=None) -> float:
     """Rough per-device bytes: params/devices + activations in flight."""
-    p, a = estimate_stage_memory_split(stage_comps, logical_mesh)
+    p, a = estimate_stage_memory_split(stage_comps, logical_mesh,
+                                       as_option=as_option)
     return p + a * num_in_flight
 
 

@@ -265,6 +265,7 @@ def compile_pipeshard_executable(fun: Callable,
         micro_avals=micro_avals,
         consts_map=consts_map,
         apply_var_mesh=apply_var_mesh,
+        invar_paths=dict(zip(global_invars, in_paths)),
     )
 
 

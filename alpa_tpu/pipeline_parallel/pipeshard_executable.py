@@ -57,7 +57,8 @@ class StageExecutable:
     """
 
     def __init__(self, name, comp, mesh_id, physical_mesh, as_option,
-                 logical_shape, donate_idx, as_overrides=None):
+                 logical_shape, donate_idx, as_overrides=None,
+                 in_paths=None):
         self.name = name
         self.comp = comp
         self.mesh_id = mesh_id
@@ -68,6 +69,10 @@ class StageExecutable:
         self._as_option = as_option
         self._logical_shape = logical_shape
         self._as_overrides = as_overrides
+        # pytree paths of stage invars that are global inputs ("" for
+        # stage-internal values) — lets the per-stage planner classify
+        # optimizer-state / param leaves for weight-update sharding
+        self._in_paths = list(in_paths) if in_paths is not None else None
         self._fun = None
         self.compiled = None
         self.plan()
@@ -92,8 +97,10 @@ class StageExecutable:
                         f"unknown AutoShardingOption field {k!r} in "
                         "submesh_autosharding_option_dicts")
                 setattr(opt, k, v)
+            in_paths = (self._in_paths if self._in_paths is not None
+                        else [""] * len(avals))
             jax_mesh, in_shardings, cfn, _shape = plan_auto_sharding(
-                fun, avals, [""] * len(avals), [], physical_mesh, opt)
+                fun, avals, in_paths, [], physical_mesh, opt)
             if cfn is not None:
                 fun = cfn  # realize the ILP plan inside the stage too
         else:
@@ -101,9 +108,19 @@ class StageExecutable:
             lm = physical_mesh.get_logical_mesh(
                 (physical_mesh.num_devices, 1))
             jax_mesh = lm.get_jax_mesh(MESH_AXIS_NAMES)
-            in_shardings = [
-                NamedSharding(jax_mesh, PartitionSpec()) for _ in avals
-            ]
+            from alpa_tpu.shard_parallel.auto_sharding import (
+                plan_rule_based, resolved_zero_stage)
+            if (physical_mesh.num_devices > 1 and
+                    self._in_paths is not None and
+                    resolved_zero_stage(as_option) in (2, 3)):
+                # manual (rule-based) stages still honor forced
+                # weight-update sharding over the stage's dp group
+                in_shardings = plan_rule_based(
+                    jax_mesh, avals, self._in_paths, [], as_option)
+            else:
+                in_shardings = [
+                    NamedSharding(jax_mesh, PartitionSpec()) for _ in avals
+                ]
         self._fun = fun
         self._avals = avals
         self.jax_mesh = jax_mesh
@@ -205,7 +222,7 @@ class PipeshardDriverExecutable:
                  schedule_name, num_micro_batches, global_invars,
                  global_outvars, batch_invars, donated_invars, grad_pairs,
                  acc_info, in_avals, micro_avals, consts_map,
-                 apply_var_mesh):
+                 apply_var_mesh, invar_paths=None):
         self.num_micro_batches = num_micro_batches
         self.global_invars = global_invars
         self.global_outvars = global_outvars
@@ -217,6 +234,9 @@ class PipeshardDriverExecutable:
         self.grad_pairs = grad_pairs
         self.acc_info = acc_info
         self.consts_map = consts_map
+        # global invar Var -> caller pytree path (keystr); lets per-stage
+        # planners and the plan verifier classify optimizer-state leaves
+        self.invar_paths: Dict[Var, str] = dict(invar_paths or {})
 
         num_stages = len(fwd_stages)
         self.num_meshes = num_stages
@@ -236,6 +256,13 @@ class PipeshardDriverExecutable:
             }
 
         # ---- compile stages ----
+        def stage_paths(comp):
+            """Caller pytree path per stage invar ("" for stage-internal
+            values) — feeds weight-update sharding classification."""
+            if not self.invar_paths:
+                return None
+            return [self.invar_paths.get(v, "") for v in comp.invars]
+
         self.stage_execs: List[StageExecutable] = []
         self._stage_of_comp = {}
         tic = time.time()
@@ -246,7 +273,8 @@ class PipeshardDriverExecutable:
             self.stage_execs.append(
                 StageExecutable(comp.name, comp, s, self.mesh_group[s],
                                 as_option, logical_shapes[s], donate,
-                                as_dicts[s] if as_dicts else None))
+                                as_dicts[s] if as_dicts else None,
+                                in_paths=stage_paths(comp)))
         for s, comp in enumerate(bwd_stages):
             donate = [
                 i for i, v in enumerate(comp.invars) if v in self.acc_pairs
@@ -254,7 +282,8 @@ class PipeshardDriverExecutable:
             self.stage_execs.append(
                 StageExecutable(comp.name, comp, s, self.mesh_group[s],
                                 as_option, logical_shapes[s], donate,
-                                as_dicts[s] if as_dicts else None))
+                                as_dicts[s] if as_dicts else None,
+                                in_paths=stage_paths(comp)))
         self.num_fwd_stages = len(fwd_stages)
         self.has_bwd = len(bwd_stages) > 0
         # Donate state inputs (params/opt state) to the apply executables
@@ -276,7 +305,8 @@ class PipeshardDriverExecutable:
                 ]
                 self.apply_execs.append(
                     StageExecutable(comp.name, comp, m, self.mesh_group[m],
-                                    as_option, logical_shapes[m], donate))
+                                    as_option, logical_shapes[m], donate,
+                                    in_paths=stage_paths(comp)))
             else:
                 self.apply_execs.append(None)
         # unify shardings of values shared across same-mesh stages, then
@@ -887,6 +917,20 @@ class PipeshardDriverExecutable:
         for v, mesh_id, _aval, sh in self.acc_allocs:
             preplaced[(v, -1, mesh_id)] = sh
 
+        # optimizer-state inputs, classified by pytree path, so the
+        # verifier's liveness pass can attribute resident bytes to
+        # alpa_opt_state_bytes{mesh} and statically prove the ZeRO
+        # saving (ISSUE 10)
+        opt_state_keys = set()
+        if self.invar_paths:
+            from alpa_tpu.shard_parallel.auto_sharding import (
+                is_opt_state_path)
+            for v, places in self.input_place.items():
+                if not is_opt_state_path(self.invar_paths.get(v, "")):
+                    continue
+                for mesh_id, _sh in places:
+                    opt_state_keys.add((v, -1, mesh_id))
+
         # program outputs are never FREEd by design — the plan
         # verifier's leak analysis must not flag them (ISSUE 8)
         protected = set()
@@ -901,7 +945,9 @@ class PipeshardDriverExecutable:
         prog = lower_to_register_file(self.instructions, preplaced,
                                       mode=mode,
                                       overlap_window=self._overlap_window(),
-                                      protected_keys=frozenset(protected))
+                                      protected_keys=frozenset(protected),
+                                      opt_state_keys=frozenset(
+                                          opt_state_keys))
         self._register_programs[mode] = prog
         if mode == "registers":
             self._register_program = prog

@@ -1128,6 +1128,7 @@ def lower_to_register_file(
         mode: str = "registers",
         overlap_window: int = 4,
         protected_keys=frozenset(),
+        opt_state_keys=frozenset(),
 ) -> RegisterFileProgram:
     """Lower the emitted instruction list into a :class:`RegisterFileProgram`.
 
@@ -1529,7 +1530,8 @@ def lower_to_register_file(
         from alpa_tpu.analysis import plan_verifier
         prog.verdict = plan_verifier.verify_program(
             instructions, prog, preplaced_shardings, recs,
-            protected_keys=protected_keys)
+            protected_keys=protected_keys,
+            opt_state_keys=opt_state_keys)
     return prog
 
 

@@ -226,7 +226,8 @@ def _stage_dp_python(C, sizes, D, B, mem_param, mem_act, mem_budget, mode=0):
 def compute_cost_cache_key(layer_comps, choices, profiling_mode,
                            with_memory=False, calibration=None,
                            db_file=None, measured_limit=None,
-                           exact_ilp=None, sharding_option=None) -> str:
+                           exact_ilp=None, sharding_option=None,
+                           objective: str = "training") -> str:
     """Content key: the layers' jaxprs + the submesh search space + the
     profiling mode + whether memory tensors were computed + the effective
     calibration.  Any change invalidates the cache.
@@ -255,6 +256,9 @@ def compute_cost_cache_key(layer_comps, choices, profiling_mode,
         h.update(repr(measured_limit).encode())
     h.update(repr(exact_ilp).encode())
     h.update(repr(sharding_option).encode())
+    # the memory tensors carry the objective-dependent optimizer-state
+    # (ZeRO) term; training vs inference tensors must not alias
+    h.update(objective.encode())
     if calibration is not None:
         h.update(repr(sorted(calibration.dot_points)).encode())
         h.update(repr(sorted(calibration.collective_ab.items())).encode())
@@ -360,7 +364,7 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
             getattr(stage_option, "profiling_mode", "cost_model"),
             with_memory=mem_budget > 0, calibration=cal, db_file=db_file,
             measured_limit=measured_limit, exact_ilp=exact_ilp,
-            sharding_option=auto_sharding_option)
+            sharding_option=auto_sharding_option, objective=objective)
         cached = load_compute_cost_cache(cache_file, cache_key, (L, L, M))
         if cached is not None:
             costs, mem_param, mem_act = cached
@@ -404,7 +408,9 @@ def auto_stage_dp(num_layers, virtual_mesh, stage_option, layer_flops,
                     for j in range(i, L):
                         mem_param[i, j, m], mem_act[i, j, m] = \
                             estimate_stage_memory_split(
-                                layer_comps[i:j + 1], logical)
+                                layer_comps[i:j + 1], logical,
+                                as_option=auto_sharding_option,
+                                objective=objective)
 
         if getattr(stage_option, "profiling_mode",
                    "cost_model") == "measured":

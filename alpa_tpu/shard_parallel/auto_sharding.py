@@ -14,17 +14,81 @@ partitioner does the rest.
 """
 import dataclasses
 import logging
+import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from alpa_tpu.device_mesh import LogicalDeviceMesh
+from alpa_tpu.global_env import global_config
 
 logger = logging.getLogger(__name__)
 
 # Mesh axis names used by shard-parallel compiled programs.
 MESH_AXIS_NAMES = ("mesh0", "mesh1")
+
+########################################
+# pytree-path classification (weight-update sharding)
+########################################
+
+# keystr paths look like ``[0].opt_state[0].mu['Dense_0']['kernel']``;
+# split them into identifier components so e.g. the component ``nu``
+# never matches a param named ``num_embeddings`` (ISSUE 10 satellite
+# bugfix — the old substring test did).
+_PATH_COMPONENT_RE = re.compile(r"[A-Za-z0-9_]+")
+
+# pytree components that mark an optimizer-state leaf: the optax/flax
+# ``opt_state`` subtree, Adam moments, SGD momentum, RMSProp trace.
+_OPT_STATE_COMPONENTS = frozenset(
+    ("opt_state", "mu", "nu", "momentum", "trace"))
+
+
+def path_components(path: str) -> Tuple[str, ...]:
+    """Identifier components of a ``jax.tree_util.keystr`` path."""
+    return tuple(_PATH_COMPONENT_RE.findall(path or ""))
+
+
+def is_opt_state_path(path: str) -> bool:
+    """True when a flat-invar path names an optimizer-state leaf.
+
+    ``opt_state`` anywhere in the path wins; outside an ``opt_state``
+    subtree, a ``params`` component wins (a parameter literally named
+    ``mu`` is still a parameter); bare moment/momentum components are
+    recognized for optimizer states passed outside a TrainState.
+    """
+    comps = set(path_components(path))
+    if "opt_state" in comps:
+        return True
+    if "params" in comps:
+        return False
+    return bool(comps & _OPT_STATE_COMPONENTS)
+
+
+def is_param_path(path: str) -> bool:
+    """True when a flat-invar path names a parameter leaf (and not an
+    optimizer-state mirror of one)."""
+    comps = set(path_components(path))
+    return "params" in comps and "opt_state" not in comps
+
+
+def resolved_zero_stage(option: "AutoShardingOption") -> int:
+    """Resolve the ``zero_stage`` knob plus the legacy forcing flags to
+    one of ``0`` (off), ``2``, ``3`` (forced), or ``-1`` (auto: the
+    solver weighs the memory term against all-gather traffic)."""
+    z = str(getattr(option, "zero_stage", "auto") or "auto")
+    if z == "auto":
+        if option.force_zero_stage_3:
+            return 3
+        if option.prefer_reduce_scatter:
+            return 2
+        return -1
+    if z not in ("0", "2", "3"):
+        raise ValueError(
+            f"zero_stage must be one of auto|0|2|3, got {z!r} "
+            "(set via AutoShardingOption.zero_stage or "
+            "ALPA_TPU_ZERO_STAGE)")
+    return int(z)
 
 
 @dataclasses.dataclass
@@ -63,6 +127,14 @@ class AutoShardingOption:
     # constrain everything.
     constrain_min_elements: int = 1 << 16
     mesh_shape_search: bool = False
+    # Weight-update (ZeRO) sharding stage: "auto" enumerates sharded
+    # optimizer-state strategies and lets the ILP pick them by cost
+    # (memory term vs all-gather traffic); "0" disables weight-update
+    # sharding entirely; "2" forces optimizer-state sharding over the
+    # dp axis (reduce-scattered grads); "3" also shards parameters.
+    # Seeded from global_config.zero_stage (env ALPA_TPU_ZERO_STAGE).
+    zero_stage: str = dataclasses.field(
+        default_factory=lambda: global_config.zero_stage)
 
     def copy(self):
         return dataclasses.replace(self)
@@ -109,6 +181,7 @@ def plan_rule_based(jax_mesh,
     """
     dp_axis = MESH_AXIS_NAMES[0]
     dp_size = int(np.prod([jax_mesh.shape[a] for a in jax_mesh.axis_names]))
+    zero = resolved_zero_stage(option)
     in_shardings = []
     batch_set = set(batch_flat_idx)
     for i, (aval, path) in enumerate(zip(avals, in_paths)):
@@ -118,11 +191,10 @@ def plan_rule_based(jax_mesh,
             spec[0] = tuple(jax_mesh.axis_names)  # batch over all axes
             in_shardings.append(NamedSharding(jax_mesh, PartitionSpec(*spec)))
             continue
-        is_opt_state = any(k in path for k in
-                           ("opt_state", "mu", "nu", "momentum", "trace"))
-        is_param = "params" in path
-        shard_it = ((option.prefer_reduce_scatter and is_opt_state) or
-                    (option.force_zero_stage_3 and (is_opt_state or is_param)))
+        is_opt_state = is_opt_state_path(path)
+        is_param = is_param_path(path)
+        shard_it = ((zero in (2, 3) and is_opt_state) or
+                    (zero == 3 and (is_opt_state or is_param)))
         if shard_it:
             d = _largest_divisible_dim(aval.shape, jax_mesh.shape[dp_axis])
             if d is not None:

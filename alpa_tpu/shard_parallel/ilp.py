@@ -104,9 +104,14 @@ def _solve_milp(graph: StrategyGraph, sizes: List[int],
     ub = np.zeros(n_cons)
     row = 0
     if has_mem:
-        # sum over invar nodes of per-strategy resident bytes <= budget
+        # sum over resident values of per-strategy bytes <= budget.
+        # invar nodes always participate (params / optimizer state live
+        # for the whole step — the term the costed ZeRO strategies
+        # shrink by 1/dp); op nodes participate when a strategy declares
+        # nonzero mem_bytes.
         for n, o in zip(graph.nodes, node_off):
-            if n.kind != "invar":
+            if n.kind != "invar" and not any(
+                    st.mem_bytes for st in n.strategies):
                 continue
             for s, st in enumerate(n.strategies):
                 A[row, o + s] = st.mem_bytes

@@ -80,6 +80,7 @@ def plan_auto_sharding(fun: Callable,
             "plan_auto_sharding",
             str(closed_jaxpr),
             repr([str(a) for a in in_avals]),
+            repr(tuple(in_paths)),
             repr(tuple(batch_flat_idx)),
             repr((physical_mesh.num_hosts, physical_mesh.num_devices)),
             option,
@@ -90,8 +91,8 @@ def plan_auto_sharding(fun: Callable,
                               {"cache": "hit"} if _ttrace.enabled()
                               else None):
                 replayed = _replay_cached_solution(
-                    closed_jaxpr, in_avals, batch_flat_idx, physical_mesh,
-                    option, entry)
+                    closed_jaxpr, in_avals, in_paths, batch_flat_idx,
+                    physical_mesh, option, entry)
             if replayed is not None:
                 cache.record_saved_seconds(
                     "ilp", entry.get("solve_seconds", 0.0))
@@ -112,7 +113,8 @@ def plan_auto_sharding(fun: Callable,
                                        physical_mesh.num_hosts == 1):
         logical_mesh = physical_mesh.get_logical_mesh(shape)
         graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
-                                     batch_flat_idx, option)
+                                     batch_flat_idx, option,
+                                     in_paths=in_paths)
         try:
             with _ttrace.span("ilp-solve-shape", "compile",
                               {"shape": str(shape)} if _ttrace.enabled()
@@ -155,8 +157,8 @@ def plan_auto_sharding(fun: Callable,
                           return_graph)
 
 
-def _replay_cached_solution(closed_jaxpr, in_avals, batch_flat_idx,
-                            physical_mesh, option, entry):
+def _replay_cached_solution(closed_jaxpr, in_avals, in_paths,
+                            batch_flat_idx, physical_mesh, option, entry):
     """Rebuild (shape, logical_mesh, graph, choice) from a cached ILP
     solution, or None if the entry no longer fits the strategy graph
     (e.g. strategy enumeration changed without a format-version bump)."""
@@ -169,7 +171,8 @@ def _replay_cached_solution(closed_jaxpr, in_avals, batch_flat_idx,
             return None
         logical_mesh = physical_mesh.get_logical_mesh(shape)
         graph = build_strategy_graph(closed_jaxpr, in_avals, logical_mesh,
-                                     batch_flat_idx, option)
+                                     batch_flat_idx, option,
+                                     in_paths=in_paths)
         if len(choice) != len(graph.nodes):
             return None
         for node, s in zip(graph.nodes, choice):
@@ -201,11 +204,18 @@ def _assemble_plan(closed_jaxpr, in_avals, in_paths, batch_flat_idx, option,
             in_shardings[i] = NamedSharding(
                 jax_mesh, spec_to_partition_spec((), axis_names))
 
-    # ZeRO-style overrides on top of the ILP plan (the reference folds these
-    # into ILP forcing flags, auto_sharding.py:225-299).
-    if option.prefer_reduce_scatter or option.force_zero_stage_3:
-        from alpa_tpu.shard_parallel.auto_sharding import (
-            _largest_divisible_dim, shard_dim)
+    # Forced ZeRO stages guarantee sharded weight-update leaves on top of
+    # the ILP plan (the reference folds these into ILP forcing flags,
+    # auto_sharding.py:225-299).  Under ``zero_stage=auto`` the strategy
+    # graph itself enumerated costed sharded candidates, so whatever the
+    # solver chose stands; under 2/3 any leaf the solver left replicated
+    # (e.g. because a consumer edge charged the all-gather) is sharded
+    # anyway — that is the contract of forcing.
+    from alpa_tpu.shard_parallel.auto_sharding import (
+        _largest_divisible_dim, is_opt_state_path, is_param_path,
+        resolved_zero_stage, shard_dim)
+    zero = resolved_zero_stage(option)
+    if zero in (2, 3):
         # The dp axis is whichever axis the ILP put the batch dim on;
         # fall back to the largest non-trivial axis.
         dp_axis_name = None
@@ -220,10 +230,9 @@ def _assemble_plan(closed_jaxpr, in_avals, in_paths, batch_flat_idx, option,
         dp = dict(jax_mesh.shape)[dp_axis_name]
         if dp > 1:
             for i, path in enumerate(in_paths):
-                is_opt = any(k in path for k in ("opt_state", "mu", "nu",
-                                                 "momentum", "trace"))
-                is_param = "params" in path and not is_opt
-                if is_opt or (option.force_zero_stage_3 and is_param):
+                is_opt = is_opt_state_path(path)
+                is_param = is_param_path(path)
+                if is_opt or (zero == 3 and is_param):
                     aval = in_avals[i]
                     d = _largest_divisible_dim(aval.shape, dp)
                     if d is not None and in_shardings[i].spec == \

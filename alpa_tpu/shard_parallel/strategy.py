@@ -874,7 +874,8 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                          in_avals: Sequence[Any],
                          logical_mesh,
                          batch_flat_idx: Sequence[int],
-                         option) -> StrategyGraph:
+                         option,
+                         in_paths: Sequence[str] = ()) -> StrategyGraph:
     jaxpr = closed_jaxpr.jaxpr
     mesh_shape = logical_mesh.shape
     nodes: List[Node] = []
@@ -894,6 +895,9 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                         [Strategy("R", replicated_spec(nd), 0.0)], label)
 
     # --- invar nodes ---
+    from alpa_tpu.shard_parallel.auto_sharding import (
+        is_opt_state_path, is_param_path, resolved_zero_stage)
+    zero = resolved_zero_stage(option)
     batch_set = set(batch_flat_idx)
     for i, (v, aval) in enumerate(zip(jaxpr.invars, in_avals)):
         specs = enumerate_var_specs(aval, mesh_shape)
@@ -905,22 +909,66 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
             if spec_valid(aval, forced, mesh_shape):
                 specs = (forced,)
         from alpa_tpu.shard_parallel.sharding_spec import sharded_bytes
-        strategies = [
-            Strategy(str(s), s, 0.0,
-                     mem_bytes=sharded_bytes(aval, s, mesh_shape),
-                     # Reference-aligned tie preferences, epsilon-sized so
-                     # any real cost difference still dominates: batch
-                     # invars prefer a sharded leading (batch) dim; other
-                     # invars (params) prefer replication (the reference's
-                     # allow_replicated_parameters default).  Together the
-                     # ties resolve toward data parallelism.
-                     tie_bias=(1e-6 if (
-                         (i in batch_set and len(aval.shape) and
-                          (not s or not s[0])) or
-                         (i not in batch_set and
-                          any(bool(d) for d in s))) else 0.0))
-            for s in specs
-        ]
+        # Weight-update (ZeRO) sharding: optimizer-state leaves (and
+        # param leaves under stage 3) get reduce-scatter-aware costed
+        # strategies instead of the replication tie preference.
+        path = in_paths[i] if i < len(in_paths) else ""
+        zero_leaf = (zero != 0 and i not in batch_set and bool(path) and
+                     (is_opt_state_path(path) or
+                      (zero == 3 and is_param_path(path))))
+        if zero_leaf and zero in (2, 3):
+            # Forced stages: restrict to sharded layouts when any exist.
+            sharded = tuple(s for s in specs if any(bool(d) for d in s))
+            if sharded:
+                specs = sharded
+        if zero_leaf:
+            nbytes = (float(np.prod(aval.shape) if aval.shape else 1) *
+                      aval.dtype.itemsize)
+            strategies = []
+            for s in specs:
+                axes = [a for dim_axes in s for a in dim_axes]
+                if axes:
+                    # Sharding a weight-update leaf trades the grad
+                    # all-reduce for reduce-scatter (credit) but must
+                    # all-gather the updated value back (charge); under
+                    # the ring model the traffic terms cancel and the
+                    # residual is the collective latency — the memory
+                    # term (mem_bytes, 1/dp of the leaf) then decides.
+                    charge = sum(logical_mesh.all_gather_cost(nbytes, a)
+                                 for a in axes)
+                    credit = sum(
+                        logical_mesh.all_reduce_cost(nbytes, a) -
+                        logical_mesh.reduce_scatter_cost(nbytes, a)
+                        for a in axes)
+                    strategies.append(Strategy(
+                        f"zero{str(s)}", s, max(0.0, charge - credit),
+                        mem_bytes=sharded_bytes(aval, s, mesh_shape),
+                        comm_kind="reduce_scatter"))
+                else:
+                    # Replication keeps the full leaf resident; carry the
+                    # tie penalty so equal-cost solutions prefer the
+                    # sharded (memory-saving) layout.
+                    strategies.append(Strategy(
+                        str(s), s, 0.0, mem_bytes=sharded_bytes(
+                            aval, s, mesh_shape), tie_bias=1e-6))
+        else:
+            strategies = [
+                Strategy(str(s), s, 0.0,
+                         mem_bytes=sharded_bytes(aval, s, mesh_shape),
+                         # Reference-aligned tie preferences, epsilon-sized
+                         # so any real cost difference still dominates:
+                         # batch invars prefer a sharded leading (batch)
+                         # dim; other invars (params) prefer replication
+                         # (the reference's allow_replicated_parameters
+                         # default).  Together the ties resolve toward
+                         # data parallelism.
+                         tie_bias=(1e-6 if (
+                             (i in batch_set and len(aval.shape) and
+                              (not s or not s[0])) or
+                             (i not in batch_set and
+                              any(bool(d) for d in s))) else 0.0))
+                for s in specs
+            ]
         n = new_node("invar", aval, strategies, f"invar{i}", invar_idx=i)
         var_node[v] = (n.idx, identity_dimmap(len(aval.shape)))
 

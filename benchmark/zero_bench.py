@@ -1,0 +1,215 @@
+"""ZeRO weight-update sharding benchmark (ISSUE 10): zero_stage x
+dp_size sweep on the GPT fixture.
+
+For every (zero_stage, dp_size) cell this measures, on a CPU mesh (the
+byte accounting is layout math — identical on TPU):
+
+- per-device parameter bytes and optimizer-state bytes, read off the
+  trained state's actual shardings (``sharding.shard_shape``);
+- static per-device peak bytes from XLA's memory analysis of the
+  compiled executable;
+- mean step wall time (3 timed steps after a warmup step).
+
+A second, fully deterministic section compiles the 2-stage pipeshard
+MLP fixture under ``zero_stage`` 0 and 2 and reports the plan
+verifier's static ``opt_state_bytes`` / ``peak_bytes`` per mesh — the
+same numbers the ``alpa_opt_state_bytes{mesh}`` gauge exports.
+
+Usage:  python benchmark/zero_bench.py [--out F] [--gate]
+
+``--gate`` checks the deterministic byte ratios against
+``benchmark/results/perf_gate_baseline.json`` (PR 9 gate) and exits
+nonzero on regression.  Writes JSON next to the other suite results
+(benchmark/results/zero_sharding.json).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results",
+                           "zero_sharding.json")
+
+
+def _per_device_bytes(leaf) -> int:
+    import numpy as np
+    shard = leaf.sharding.shard_shape(leaf.shape)
+    n = int(np.prod(shard)) if shard else 1
+    return n * leaf.dtype.itemsize
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    return sum(_per_device_bytes(x)
+               for x in jax.tree_util.tree_leaves(tree)
+               if hasattr(x, "sharding"))
+
+
+def _gpt_train_state(batch_size=4):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax.training import train_state
+
+    from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
+
+    config = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=4, seq_len=32)
+    model = GPTModel(config)
+    rngkey = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rngkey, (batch_size, config.seq_len),
+                                   0, config.vocab_size, jnp.int32)
+    params = model.init(rngkey, input_ids)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.adam(learning_rate=1e-3))
+    batch = {"input_ids": input_ids,
+             "labels": jnp.roll(input_ids, -1, axis=1)}
+    return state, batch
+
+
+def _train_step(method):
+    import jax.numpy as jnp
+
+    import alpa_tpu
+    from alpa_tpu.model.model_util import gpt_lm_loss
+
+    def step(state, batch):
+        def loss_fn(p):
+            return gpt_lm_loss(state.apply_fn, p, batch)
+        val, grads = alpa_tpu.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), val
+
+    return alpa_tpu.parallelize(step, method=method)
+
+
+def bench_cell(zero_stage: str, dp: int, n_steps: int = 3) -> dict:
+    import jax
+
+    from alpa_tpu.parallel_method import ShardParallel
+    from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+
+    method = ShardParallel(
+        devices=jax.devices()[:dp],
+        auto_sharding_option=AutoShardingOption(
+            enable_auto_sharding=False, force_data_parallel=True,
+            zero_stage=zero_stage))
+    step = _train_step(method)
+    state, batch = _gpt_train_state()
+    state, loss = step(state, batch)           # compile + warmup
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step(state, batch)
+        jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / n_steps
+
+    ex = step.get_last_executable()
+    return {
+        "zero_stage": zero_stage,
+        "dp_size": dp,
+        "loss": float(loss),
+        "param_bytes_per_device": _tree_bytes(state.params),
+        "opt_state_bytes_per_device": _tree_bytes(state.opt_state),
+        "peak_bytes_per_device": ex.get_total_allocation_size(),
+        "step_seconds": round(dt, 4),
+    }
+
+
+def bench_pipeshard_static() -> dict:
+    """Deterministic: static plan-verifier byte accounting of the
+    2-stage pipeshard fixture under zero_stage 0 vs 2."""
+    import alpa_tpu
+    from alpa_tpu.parallel_method import PipeshardParallel
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        ManualLayerOption)
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+    from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    out = {}
+    for stage in ("0", "2"):
+        method = PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=ManualLayerOption(),
+            stage_option=UniformStageOption(num_stages=2),
+            default_auto_sharding_option=AutoShardingOption(
+                zero_stage=stage))
+        state, batch = create_mlp_train_state_and_batch(
+            batch_size=64, num_layers=4, manual_pipeline_layer=True)
+        pstep = get_mlp_train_step(method, use_value_and_grad=True)
+        state, _ = pstep(state, batch)
+        v = pstep.get_last_executable().get_plan_verdict()
+        out[f"stage{stage}"] = {
+            "opt_state_bytes": v.stats["opt_state_bytes"],
+            "peak_bytes": v.stats["peak_bytes"],
+            "zero_bytes_saved": v.stats["zero_bytes_saved"],
+        }
+    return out
+
+
+def run() -> dict:
+    import jax
+
+    import alpa_tpu
+    alpa_tpu.init("local")
+
+    n_dev = len(jax.devices())
+    dps = [d for d in (2, 4, 8) if d <= n_dev]
+    cells = [bench_cell(zs, dp)
+             for zs in ("0", "2", "3") for dp in dps]
+    pipeshard = bench_pipeshard_static()
+
+    # deterministic gate metrics: pure layout ratios (byte math only)
+    gate_metrics = {}
+    by = {(c["zero_stage"], c["dp_size"]): c for c in cells}
+    for dp in dps:
+        z0, z2 = by[("0", dp)], by[("2", dp)]
+        gate_metrics[f"zero.opt_bytes_ratio_stage2_dp{dp}"] = (
+            z0["opt_state_bytes_per_device"] /
+            max(z2["opt_state_bytes_per_device"], 1))
+    p0 = sum(pipeshard["stage0"]["opt_state_bytes"].values())
+    p2 = sum(pipeshard["stage2"]["opt_state_bytes"].values())
+    gate_metrics["zero.pipeshard_opt_bytes_ratio"] = p0 / max(p2, 1.0)
+    gate_metrics["zero.pipeshard_bytes_saved"] = (
+        pipeshard["stage2"]["zero_bytes_saved"])
+
+    return {"cells": cells, "pipeshard_static": pipeshard,
+            "gate_metrics": {k: round(v, 4)
+                             for k, v in gate_metrics.items()},
+            "n_devices": n_dev}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--gate", action="store_true",
+                        help="check the deterministic byte ratios "
+                             "against the committed perf-gate baseline")
+    args = parser.parse_args()
+
+    result = run()
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    print(f"\nwrote {args.out}")
+
+    if args.gate:
+        from benchmark.perf_gate import gate
+        verdict = gate(result["gate_metrics"])
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit("ZERO BENCH PERF GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,12 @@ Usage::
                                              [--keep-every-n N]
     python scripts/ckpt_tool.py stat
 
-``inspect`` lists committed steps (or one step's per-leaf chunk map);
+``inspect`` lists committed steps; with ``--step`` it prints one
+step's per-leaf chunk map including the sharding layout each leaf was
+saved under (per-dim piece counts from the chunk index-maps) and a
+params vs optimizer-state byte summary — under ZeRO weight-update
+sharding (docs/performance.md) the opt-state leaves show partitioned
+layouts while params stay ``full``;
 ``verify`` re-hashes every chunk a step references and exits non-zero
 on corruption; ``gc`` optionally applies a retention policy, then
 deletes chunks no surviving manifest references (do NOT run it while a
@@ -43,7 +48,26 @@ def _fmt_bytes(n: int) -> str:
     return f"{n}B"
 
 
+def _leaf_layout(leaf) -> str:
+    """Sharding layout recorded at save time, read off the chunk
+    index-maps: per-dim piece counts (``4x1`` = dim 0 cut in 4), or
+    ``full`` when one extent covers the whole leaf.  Chunk-size
+    splitting only subdivides dim 0 of an existing piece, so counts > 1
+    on later dims always mean a real partitioned save."""
+    shape = tuple(leaf["shape"])
+    idxs = [e.get("index") for e in leaf["chunks"]]
+    if not shape or not idxs or any(not ix for ix in idxs):
+        return "full"
+    cuts = [len({(int(a), int(b)) for ix in idxs
+                 for a, b in [ix[d]]}) for d in range(len(shape))]
+    if all(c == 1 for c in cuts):
+        return "full"
+    return "x".join(str(c) for c in cuts)
+
+
 def cmd_inspect(args):
+    from alpa_tpu.shard_parallel.auto_sharding import (is_opt_state_path,
+                                                      path_components)
     store = _store(args)
     if args.step is not None:
         manifest = store.read_manifest(args.step)
@@ -51,12 +75,35 @@ def cmd_inspect(args):
               f"plan={str(manifest.get('plan_fingerprint'))[:16]}  "
               f"meta={manifest.get('meta')}")
         print(f"{'leaf':<40} {'shape':<18} {'dtype':<10} "
-              f"{'chunks':>6} {'bytes':>10}")
+              f"{'chunks':>6} {'layout':>8} {'bytes':>10}")
+        totals = {}  # group -> [n_leaves, n_sharded, bytes]
         for name, leaf in sorted(manifest["leaves"].items()):
             nbytes = sum(e["nbytes"] for e in leaf["chunks"])
+            layout = _leaf_layout(leaf)
             print(f"{name:<40} {str(tuple(leaf['shape'])):<18} "
                   f"{leaf['dtype']:<10} {len(leaf['chunks']):>6} "
-                  f"{_fmt_bytes(nbytes):>10}")
+                  f"{layout:>8} {_fmt_bytes(nbytes):>10}")
+            if is_opt_state_path(name):
+                group = "opt_state"
+            elif "params" in path_components(name):
+                group = "params"
+            else:
+                group = "other"
+            t = totals.setdefault(group, [0, 0, 0])
+            t[0] += 1
+            t[1] += layout != "full"
+            t[2] += nbytes
+        print()
+        for group in ("params", "opt_state", "other"):
+            if group not in totals:
+                continue
+            n, n_sharded, nbytes = totals[group]
+            print(f"{group:<10} {n:>4} leaves  {_fmt_bytes(nbytes):>10}"
+                  f"  ({n_sharded} saved in pieces)")
+        if totals.get("params", [0, 0, 0])[2]:
+            ratio = (totals.get("opt_state", [0, 0, 0])[2] /
+                     totals["params"][2])
+            print(f"opt_state / params byte ratio: {ratio:.2f}")
         return
     steps = store.all_steps()
     if not steps:

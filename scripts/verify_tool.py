@@ -3,6 +3,8 @@
 Usage::
 
     python scripts/verify_tool.py verify plan [--dir DIR] [--all] [--json]
+    python scripts/verify_tool.py verify zero-delta [--dir DIR]
+                                                    [--a KEY --b KEY] [--json]
     python scripts/verify_tool.py verify lint [--json]
 
 ``verify plan`` prints the cached :class:`PlanVerdict` of every lowered
@@ -18,6 +20,14 @@ errors.
 config-knob env/doc coverage, metric naming, deprecated-timer imports,
 fault-site registry — and exits 1 on any violation.  The same lint
 gates tier-1 via ``tests/util/test_repo_lint.py``.
+
+``verify zero-delta`` compares two cached verdicts' static per-mesh
+byte accounting — compile the same program once under ``zero_stage=0``
+and once under ``zero_stage=2`` into the same cache dir, then run this
+to see what the sharded weight-update layout saves: per-mesh
+``peak_bytes`` delta, per-mesh ``opt_state_bytes`` ratio, and the
+verifier's ``zero_bytes_saved`` total (docs/performance.md).  Defaults
+to the two newest verdicts; ``--a``/``--b`` select by key prefix.
 """
 import argparse
 import json
@@ -38,12 +48,7 @@ def _age(mtime: float) -> str:
 
 
 def cmd_plan(args):
-    from alpa_tpu.analysis import plan_verifier
-    cache = None
-    if args.dir:
-        from alpa_tpu.compile_cache import CompileCache
-        cache = CompileCache(cache_dir=args.dir)
-    cached = plan_verifier.load_cached_verdicts(cache)
+    cached = _load_verdicts(args)
     if not cached:
         where = args.dir or os.environ.get("ALPA_TPU_CACHE_DIR") or (
             "(memory only — set ALPA_TPU_CACHE_DIR)")
@@ -65,6 +70,80 @@ def cmd_plan(args):
                   f"--all to show)")
     if any(not e["verdict"].ok for e in shown):
         sys.exit(1)
+
+
+def _load_verdicts(args):
+    from alpa_tpu.analysis import plan_verifier
+    cache = None
+    if args.dir:
+        from alpa_tpu.compile_cache import CompileCache
+        cache = CompileCache(cache_dir=args.dir)
+    return plan_verifier.load_cached_verdicts(cache)
+
+
+def _pick(cached, prefix, label):
+    hits = [e for e in cached if e["key"].startswith(prefix)]
+    if not hits:
+        sys.exit(f"no cached verdict with key prefix {prefix!r} "
+                 f"for {label}")
+    return hits[0]
+
+
+def cmd_zero_delta(args):
+    cached = _load_verdicts(args)
+    if len(cached) < 2:
+        sys.exit(f"need two cached verdicts to diff, found "
+                 f"{len(cached)}; compile the program under "
+                 f"zero_stage=0 and zero_stage=2 with "
+                 f"ALPA_TPU_CACHE_DIR set")
+    if args.a or args.b:
+        if not (args.a and args.b):
+            sys.exit("--a and --b must be given together")
+        ea, eb = _pick(cached, args.a, "--a"), _pick(cached, args.b,
+                                                    "--b")
+    else:
+        eb, ea = cached[0], cached[1]  # newest last-compiled = sharded
+    sa, sb = ea["verdict"].stats, eb["verdict"].stats
+    # orient so `a` is the replicated (more opt-state bytes) plan
+    if sum(sa.get("opt_state_bytes", {}).values()) < \
+            sum(sb.get("opt_state_bytes", {}).values()):
+        ea, eb, sa, sb = eb, ea, sb, sa
+    meshes = sorted(set(sa.get("peak_bytes", {}))
+                    | set(sb.get("peak_bytes", {})), key=str)
+    rows = []
+    for m in meshes:
+        pa = float(sa.get("peak_bytes", {}).get(m, 0.0))
+        pb = float(sb.get("peak_bytes", {}).get(m, 0.0))
+        oa = float(sa.get("opt_state_bytes", {}).get(m, 0.0))
+        ob = float(sb.get("opt_state_bytes", {}).get(m, 0.0))
+        rows.append({"mesh": str(m), "peak_bytes_a": pa,
+                     "peak_bytes_b": pb, "peak_delta": pa - pb,
+                     "opt_state_bytes_a": oa, "opt_state_bytes_b": ob,
+                     "opt_state_ratio":
+                         round(oa / ob, 4) if ob else None})
+    result = {"plan_a": {"key": ea["key"], "mtime": ea["mtime"]},
+              "plan_b": {"key": eb["key"], "mtime": eb["mtime"]},
+              "per_mesh": rows,
+              "zero_bytes_saved_b":
+                  float(sb.get("zero_bytes_saved", 0.0))}
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+        return
+    print(f"plan a (replicated opt state): {ea['key'][:16]}..  "
+          f"(compiled {_age(ea['mtime'])} ago)")
+    print(f"plan b (sharded opt state):    {eb['key'][:16]}..  "
+          f"(compiled {_age(eb['mtime'])} ago)")
+    print(f"{'mesh':<6} {'peak a':>12} {'peak b':>12} {'delta':>12} "
+          f"{'opt a':>12} {'opt b':>12} {'opt ratio':>10}")
+    for r in rows:
+        ratio = (f"{r['opt_state_ratio']:.2f}x"
+                 if r["opt_state_ratio"] is not None else "-")
+        print(f"{r['mesh']:<6} {r['peak_bytes_a']:>12.0f} "
+              f"{r['peak_bytes_b']:>12.0f} {r['peak_delta']:>12.0f} "
+              f"{r['opt_state_bytes_a']:>12.0f} "
+              f"{r['opt_state_bytes_b']:>12.0f} {ratio:>10}")
+    print(f"plan b zero sharding saves "
+          f"{result['zero_bytes_saved_b']:.0f} B/device vs replicated")
 
 
 def cmd_lint(args):
@@ -93,6 +172,18 @@ def main():
                    help="show every cached verdict, not just the newest")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=cmd_plan)
+    z = vsub.add_parser(
+        "zero-delta",
+        help="per-mesh peak/opt-state byte delta between a replicated "
+             "and a ZeRO-sharded cached plan verdict")
+    z.add_argument("--dir", default=None,
+                   help="compile cache dir (default: $ALPA_TPU_CACHE_DIR)")
+    z.add_argument("--a", default=None,
+                   help="key prefix of the replicated (zero_stage=0) plan")
+    z.add_argument("--b", default=None,
+                   help="key prefix of the sharded (zero_stage=2) plan")
+    z.add_argument("--json", action="store_true")
+    z.set_defaults(fn=cmd_zero_delta)
     l = vsub.add_parser("lint", help="run the AST repo lint")
     l.add_argument("--json", action="store_true")
     l.set_defaults(fn=cmd_lint)

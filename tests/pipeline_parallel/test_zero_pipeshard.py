@@ -1,0 +1,74 @@
+"""ZeRO weight-update sharding on pipeshard (ISSUE 10): each stage's
+apply_grad runs with optimizer state sharded over that stage's submesh
+data-parallel replicas, and the plan verifier proves the per-device
+optimizer-state reduction statically (``alpa_opt_state_bytes{mesh}``,
+``alpa_plan_peak_bytes{mesh}``) — before anything runs.
+"""
+import numpy as np
+
+import alpa_tpu
+from alpa_tpu import PipeshardParallel
+from alpa_tpu.pipeline_parallel.layer_construction import ManualLayerOption
+from alpa_tpu.pipeline_parallel.stage_construction import UniformStageOption
+from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+from alpa_tpu.testing import (assert_allclose,
+                              create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+def _run_pipeshard(zero_stage, n_steps=2):
+    alpa_tpu.init(cluster="local")
+    method = PipeshardParallel(
+        num_micro_batches=2,
+        layer_option=ManualLayerOption(),
+        stage_option=UniformStageOption(num_stages=2),
+        pipeline_schedule="1f1b",
+        default_auto_sharding_option=AutoShardingOption(
+            zero_stage=zero_stage))
+    state_p, batch = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    state_s, _ = create_mlp_train_state_and_batch(
+        batch_size=64, num_layers=4, manual_pipeline_layer=True)
+    pstep = get_mlp_train_step(method, use_value_and_grad=True)
+    serial = get_mlp_train_step(None)
+    for _ in range(n_steps):
+        state_p, loss_p = pstep(state_p, batch)
+        state_s, loss_s = serial(state_s, batch)
+    assert_allclose(float(loss_s), float(loss_p), 2e-3, 2e-3)
+    return float(loss_p), pstep.get_last_executable()
+
+
+class TestZeroPipeshard:
+
+    def test_zero2_two_stage_matches_serial_and_shrinks_opt_state(self):
+        loss0, ex0 = _run_pipeshard("0")
+        loss2, ex2 = _run_pipeshard("2")
+        # layout change only: both agree with serial (asserted inside)
+        # and with each other bitwise
+        np.testing.assert_array_equal(np.float32(loss0),
+                                      np.float32(loss2))
+
+        v0 = ex0.get_plan_verdict()
+        v2 = ex2.get_plan_verdict()
+        opt0 = sum(v0.stats["opt_state_bytes"].values())
+        opt2 = sum(v2.stats["opt_state_bytes"].values())
+        assert opt0 > 0 and opt2 > 0
+        # acceptance: per-device opt-state bytes drop >= (dp - eps)x;
+        # each 2-stage submesh of the 8-device test mesh has dp = 4
+        dp = max(m.num_devices for m in ex2.mesh_group)
+        assert opt0 / opt2 >= dp - 0.25, (opt0, opt2, dp)
+        # the saving is attributed, and peak memory proves it statically
+        assert v2.stats["zero_bytes_saved"] > 0
+        assert v0.stats["zero_bytes_saved"] == 0
+        peak0 = sum(v0.stats["peak_bytes"].values())
+        peak2 = sum(v2.stats["peak_bytes"].values())
+        assert peak2 < peak0
+        # zero_stage is covered by the plan fingerprint (resume safety)
+        assert ex0.get_plan_fingerprint() != ex2.get_plan_fingerprint()
+
+    def test_opt_state_gauges_exported(self):
+        from alpa_tpu.telemetry import metrics as tmetrics
+        _run_pipeshard("2")
+        text = tmetrics.get_registry().to_prometheus_text()
+        assert "alpa_opt_state_bytes" in text
+        assert "alpa_zero_bytes_saved_total" in text

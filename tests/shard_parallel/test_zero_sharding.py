@@ -1,0 +1,149 @@
+"""ZeRO weight-update sharding (ISSUE 10): path classification,
+cost-modeled optimizer-state partitioning, and numeric equivalence.
+
+Oracle: ZeRO-2/ZeRO-3 are pure *layout* changes — losses must match the
+replicated data-parallel baseline bitwise; the memory-budgeted ILP must
+pick sharded optimizer state on its own (chosen by cost, not forced).
+"""
+import numpy as np
+import pytest
+
+import alpa_tpu
+from alpa_tpu.parallel_method import (DataParallel, ShardParallel,
+                                      Zero2Parallel, Zero3Parallel)
+from alpa_tpu.shard_parallel.auto_sharding import (
+    AutoShardingOption, is_opt_state_path, is_param_path, path_components,
+    resolved_zero_stage)
+from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                              get_mlp_train_step)
+
+
+class TestPathClassification:
+    """plan_rule_based used to match optimizer-state leaves by raw
+    substring (``"nu" in path`` also hit ``num_*``); classification now
+    matches path *components*."""
+
+    def test_opt_state_paths(self):
+        assert is_opt_state_path("[0].opt_state[0].mu['Dense_0']['kernel']")
+        assert is_opt_state_path("[0].opt_state[0].nu['head']['bias']")
+        assert is_opt_state_path(".opt_state.trace['Dense_0']['kernel']")
+        assert is_opt_state_path(".mu['Dense_0']['kernel']")
+
+    def test_adversarial_param_names_are_not_opt_state(self):
+        # "nu" inside "num_embeddings"/"nu_head" and "trace" inside
+        # "trace_proj" must NOT classify as optimizer state
+        for path in (".params['num_embeddings']['kernel']",
+                     ".params['nu_head']['kernel']",
+                     ".params['trace_proj']['bias']",
+                     ".params['momentum_encoder']['kernel']"):
+            assert not is_opt_state_path(path), path
+            assert is_param_path(path), path
+
+    def test_mirror_tree_precedence(self):
+        # optax moment trees mirror the params tree: a "params" component
+        # under opt_state is still optimizer state
+        p = "[0].opt_state[0].mu['params']['Dense_0']['kernel']"
+        assert is_opt_state_path(p)
+        assert not is_param_path(p)
+
+    def test_path_components(self):
+        assert path_components(".opt_state[0].mu['nu_head']") == \
+            ("opt_state", "0", "mu", "nu_head")
+
+    def test_resolved_zero_stage(self):
+        assert resolved_zero_stage(AutoShardingOption(zero_stage="0")) == 0
+        assert resolved_zero_stage(AutoShardingOption(zero_stage="2")) == 2
+        assert resolved_zero_stage(AutoShardingOption(zero_stage="3")) == 3
+        assert resolved_zero_stage(AutoShardingOption()) == -1
+        # legacy flags force a stage under "auto"
+        assert resolved_zero_stage(AutoShardingOption(
+            prefer_reduce_scatter=True)) == 2
+        assert resolved_zero_stage(AutoShardingOption(
+            force_zero_stage_3=True)) == 3
+        with pytest.raises(ValueError, match="zero_stage"):
+            resolved_zero_stage(AutoShardingOption(zero_stage="1"))
+
+
+def _train(method, n_steps=2, batch_size=16, hidden_dim=64):
+    state, batch = create_mlp_train_state_and_batch(
+        batch_size, hidden_dim=hidden_dim)
+    step = get_mlp_train_step(method, use_value_and_grad=True)
+    for _ in range(n_steps):
+        state, loss = step(state, batch)
+    return state, loss, step.get_last_executable()
+
+
+def _sharded_input_count(ex):
+    n = 0
+    for sh, av in zip(ex.in_shardings, ex.in_avals):
+        if av.shape and np.prod(sh.shard_shape(av.shape)) < \
+                np.prod(av.shape):
+            n += 1
+    return n
+
+
+class TestZeroNumerics:
+    """ZeRO stages vs replicated DP: identical losses, sharded state."""
+
+    def test_zero2_bit_exact_vs_dp(self):
+        alpa_tpu.init("local")
+        _, loss_dp, _ = _train(DataParallel())
+        state2, loss_z2, ex2 = _train(Zero2Parallel())
+        np.testing.assert_array_equal(np.asarray(loss_dp),
+                                      np.asarray(loss_z2))
+        # the optimizer-state leaves really are partitioned
+        opt_leaf = state2.opt_state[0].trace["params"]["Dense_0"]["kernel"]
+        assert np.prod(opt_leaf.sharding.shard_shape(opt_leaf.shape)) < \
+            np.prod(opt_leaf.shape)
+
+    def test_zero3_bit_exact_vs_dp(self):
+        alpa_tpu.init("local")
+        _, loss_dp, _ = _train(DataParallel())
+        state3, loss_z3, _ = _train(Zero3Parallel())
+        np.testing.assert_array_equal(np.asarray(loss_dp),
+                                      np.asarray(loss_z3))
+        # ZeRO-3 also shards the parameters
+        p = state3.params["params"]["Dense_0"]["kernel"]
+        assert np.prod(p.sharding.shard_shape(p.shape)) < np.prod(p.shape)
+
+    def test_zero_stage_knob_forces_sharding(self):
+        alpa_tpu.init("local")
+        _, loss0, ex0 = _train(ShardParallel(
+            auto_sharding_option=AutoShardingOption(zero_stage="0")))
+        _, loss2, ex2 = _train(ShardParallel(
+            auto_sharding_option=AutoShardingOption(zero_stage="2")))
+        np.testing.assert_array_equal(np.asarray(loss0),
+                                      np.asarray(loss2))
+        assert _sharded_input_count(ex2) > _sharded_input_count(ex0)
+        # zero_stage is part of the parallel plan: resume validation
+        # (checkpoint manager) must distinguish the two layouts
+        assert ex0.get_plan_fingerprint() != ex2.get_plan_fingerprint()
+
+
+class TestCostModeledChoice:
+    """The tentpole claim: ZeRO-2 chosen BY COST under ``zero_stage=
+    "auto"`` — a per-device memory budget that replicated optimizer
+    state cannot satisfy flips the ILP to reduce-scatter-aware sharded
+    strategies; a generous budget keeps replication (all-gather latency
+    is charged, memory is not needed)."""
+
+    def _state_bytes(self):
+        import jax
+        state, _ = create_mlp_train_state_and_batch(16, hidden_dim=64)
+        return sum(
+            np.prod(a.shape) * a.dtype.itemsize
+            for a in jax.tree_util.tree_leaves(state)
+            if hasattr(a, "shape") and a.shape)
+
+    def test_budget_flips_ilp_to_sharded_opt_state(self):
+        alpa_tpu.init("local")
+        _, loss_g, ex_g = _train(ShardParallel(
+            auto_sharding_option=AutoShardingOption()))
+        tight = int(self._state_bytes() * 0.66)
+        _, loss_t, ex_t = _train(ShardParallel(
+            auto_sharding_option=AutoShardingOption(
+                memory_budget_per_device=tight)))
+        # same math, different layout
+        np.testing.assert_array_equal(np.asarray(loss_g),
+                                      np.asarray(loss_t))
+        assert _sharded_input_count(ex_t) > _sharded_input_count(ex_g)
