@@ -235,6 +235,54 @@ class GlobalConfig:
         self.device_peak_tflops = float(os.environ.get(
             "ALPA_TPU_DEVICE_PEAK_TFLOPS", "0"))
 
+        # ---------- serving: paged KV cache + router (ISSUE 11) ------
+        # Master switch: controller replicas build their streaming
+        # engines over a serve.kv_cache.KVBlockPool (fixed-size token
+        # blocks, refcounted block tables, upfront reservation).  Decode
+        # stays bit-exact vs the unpaged engine.
+        self.kv_paged = _env_bool("ALPA_TPU_KV_PAGED", False)
+        # Tokens per KV block; must divide the model's seq_len.
+        self.kv_block_size = int(os.environ.get(
+            "ALPA_TPU_KV_BLOCK_SIZE", "16"))
+        # Pool capacity in blocks; 0 = auto (two engine batches' worth:
+        # one for live sequences, one of headroom for cached prefixes).
+        self.kv_cache_blocks = int(os.environ.get(
+            "ALPA_TPU_KV_CACHE_BLOCKS", "0"))
+        # Cross-request prefix reuse: full prompt/output blocks are
+        # published to a hash-chain index (LRU-evicted under pressure);
+        # admissions sharing a token prefix skip recomputing those
+        # blocks.  Off keeps paging but recomputes every prompt, and
+        # preserves the legacy one-static-PrefixHandle register_model
+        # semantics (docs/serving.md).
+        self.kv_prefix_reuse = _env_bool("ALPA_TPU_KV_PREFIX_REUSE", True)
+        # serve.router placement policy: "least_loaded" scores replicas
+        # by queue depth + in-flight + tokens; "round_robin" rotates.
+        self.router_policy = os.environ.get(
+            "ALPA_TPU_ROUTER_POLICY", "least_loaded")
+        # Per-replica saturation: a replica whose request p99 exceeds
+        # this (milliseconds) is routed around; 0 disables the check.
+        self.router_shed_ttft_ms = float(os.environ.get(
+            "ALPA_TPU_ROUTER_SHED_TTFT_MS", "0"))
+        # Per-replica saturation: queue depth above which a replica is
+        # routed around; requests shed (503) only when EVERY healthy
+        # replica is saturated.  0 disables.
+        self.router_shed_queue_depth = int(os.environ.get(
+            "ALPA_TPU_ROUTER_SHED_QUEUE_DEPTH", "64"))
+        # Consecutive failed /healthz probes before a replica is
+        # dropped from rotation (one clean probe restores it).
+        self.router_health_fail_threshold = int(os.environ.get(
+            "ALPA_TPU_ROUTER_HEALTH_FAILS", "3"))
+        # Autoscale hooks: sliding evaluation window (seconds) over
+        # aggregate queue depth...
+        self.router_autoscale_window_s = float(os.environ.get(
+            "ALPA_TPU_ROUTER_AUTOSCALE_WINDOW", "30"))
+        # ...sustained above hi fires on_want_more, sustained below lo
+        # fires on_want_fewer (per-replica averages).
+        self.router_autoscale_hi_queue = float(os.environ.get(
+            "ALPA_TPU_ROUTER_AUTOSCALE_HI_QUEUE", "8"))
+        self.router_autoscale_lo_queue = float(os.environ.get(
+            "ALPA_TPU_ROUTER_AUTOSCALE_LO_QUEUE", "1"))
+
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS
         # (ref: DaemonMoveWorker).

@@ -26,12 +26,14 @@ import json
 import logging
 import threading
 import time
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from alpa_tpu import fault
+from alpa_tpu.global_env import global_config
 from alpa_tpu.serve.generation import GenerationConfig, Generator
 from alpa_tpu.telemetry import metrics as _tmetrics
 from alpa_tpu.telemetry import trace as _ttrace
@@ -231,7 +233,8 @@ class RequestBatcher:
 class _Replica:
 
     def __init__(self, generator: Generator, prefix=None,
-                 scheduler_factory=None, on_degraded=None):
+                 scheduler_factory=None, on_degraded=None,
+                 warm_prefix_ids=None):
         self.generator = generator
         self.batcher = RequestBatcher(
             generator, prefix=prefix,
@@ -239,6 +242,9 @@ class _Replica:
         self.batcher.on_degraded = on_degraded
         self.prefix = prefix
         self.scheduler_factory = scheduler_factory
+        #: system prompt to pre-warm into the paged prefix index when
+        #: the streaming engine is built (kv_paged + kv_prefix_reuse)
+        self.warm_prefix_ids = warm_prefix_ids
         self._engine = None
         self._lock = threading.Lock()
 
@@ -293,16 +299,37 @@ class _Replica:
         """Lazy continuous-batching engine for streaming requests (so
         non-streaming deployments never spin its decode thread).  When
         the model was registered with a prefix, every streamed request's
-        prompt_ids are a SUFFIX over that shared system prompt."""
+        prompt_ids are a SUFFIX over that shared system prompt — unless
+        ``kv_paged`` + ``kv_prefix_reuse`` are on, in which case the
+        prefix is pre-warmed into the engine's paged block pool and
+        requests send FULL prompts (any shared token prefix hits).  A
+        hot weight swap rebuilds engine AND pool together: cached KV is
+        only valid for the params that produced it."""
         with self._lock:
             if self._engine is None:
                 from alpa_tpu.serve.engine import ContinuousBatchingEngine
                 sched = (self.scheduler_factory()
                          if self.scheduler_factory else None)
+                pool = None
+                if global_config.kv_paged:
+                    if self.prefix is not None:
+                        # kv_prefix_reuse=off kept the PrefixHandle
+                        # suffix semantics; those are incompatible with
+                        # block tables, so this replica stays unpaged
+                        logger.warning(
+                            "kv_paged with a static PrefixHandle "
+                            "(kv_prefix_reuse=off): replica keeps the "
+                            "unpaged suffix engine")
+                    else:
+                        from alpa_tpu.serve.kv_cache import KVBlockPool
+                        pool = KVBlockPool.for_generator(self.generator)
                 self._engine = ContinuousBatchingEngine(
                     self.generator,
                     prompt_bucket=self.generator.prompt_buckets[-1],
-                    prefix=self.prefix, scheduler=sched)
+                    prefix=None if pool is not None else self.prefix,
+                    scheduler=sched, kv_pool=pool)
+                if pool is not None and self.warm_prefix_ids is not None:
+                    pool.warm_prefix(self.generator, self.warm_prefix_ids)
             return self._engine
 
 
@@ -324,6 +351,9 @@ class Controller:
         self._recovery = None
         #: completed hot swaps, newest last (introspection + /admin)
         self.reloads: List[Dict[str, Any]] = []
+        #: recent request latencies (seconds) feeding load_report's p99
+        #: — the router's load-aware placement signal (serve.router)
+        self._latencies = deque(maxlen=512)
 
     # -- health / graceful degradation --------------------------------
 
@@ -350,6 +380,32 @@ class Controller:
             report["degraded_models"] = degraded
         return report
 
+    def load_report(self) -> Dict[str, Any]:
+        """Load signals for the multi-replica router (serve.router) and
+        ``/healthz``: total queued requests (batcher + engine queues),
+        tokens held by in-flight streams, and a request-latency p99 over
+        the recent window (ms; ``None`` before any traffic)."""
+        depth = 0
+        tokens_in_flight = 0
+        with self._lock:
+            replicas = [r for reps in self._models.values() for r in reps]
+        for rep in replicas:
+            depth += len(rep.batcher._queue)
+            eng = rep._engine
+            if eng is None:
+                continue
+            with eng._cv:
+                depth += len(eng._queue)
+                for it in eng._rows:
+                    if it is not None:
+                        tokens_in_flight += (len(it["prompt"]) +
+                                             len(it["tokens"]))
+        lat = sorted(self._latencies)
+        p99 = lat[int(0.99 * (len(lat) - 1))] * 1e3 if lat else None
+        return {"queue_depth": depth,
+                "tokens_in_flight": tokens_in_flight,
+                "ttft_p99_ms": p99}
+
     def attach_recovery(self, recovery) -> None:
         """Bind a :class:`alpa_tpu.fault.RecoveryManager`: entering
         DEGRADED sheds load here (503s), recovering restores service."""
@@ -369,12 +425,22 @@ class Controller:
 
     def register_model(self, name: str, generator: Generator,
                        prefix_ids=None, scheduler_factory=None):
-        """``prefix_ids``: optional shared system prompt — its KV is
-        precomputed once (Generator.cache_prefix; requires the
+        """``prefix_ids``: optional shared system prompt.
+
+        Default (``kv_paged`` off, or ``kv_prefix_reuse`` off): its KV
+        is precomputed once (Generator.cache_prefix; requires the
         generator's chunked-prefill mode) and every request to this
         model (batched or streamed) sends only its suffix.  All
         replicas of one model must register the SAME prefix: round-robin
         dispatch must not change what prompt_ids mean.
+
+        With ``kv_paged`` + ``kv_prefix_reuse`` (ISSUE 11) that
+        limitation is SUPERSEDED on the streaming path: the prefix is
+        pre-warmed into the replica's paged prefix index instead
+        (``serve.kv_cache.KVBlockPool.warm_prefix``), requests send
+        FULL prompts, any shared token prefix — warmed or organic —
+        hits the block cache, and different replicas may warm different
+        prefixes (no consistency error).
 
         ``scheduler_factory``: builds this replica's admission policy
         (``serve.scheduler``, e.g.
@@ -383,6 +449,25 @@ class Controller:
         ``"queue"`` field to pick their named queue on either path."""
         prefix_ids = (None if prefix_ids is None
                       else np.asarray(prefix_ids, np.int32).reshape(-1))
+        if global_config.kv_paged and global_config.kv_prefix_reuse:
+            # paged prefix reuse: no shared PrefixHandle, no
+            # one-prefix-per-model constraint — prompt_ids are always
+            # full prompts, so dispatch cannot change their meaning
+            with self._lock:
+                self._models.setdefault(name, []).append(
+                    _Replica(generator,
+                             scheduler_factory=scheduler_factory,
+                             warm_prefix_ids=prefix_ids,
+                             on_degraded=lambda e, n=name: logger.warning(
+                                 "model %s replica degraded to FIFO: %s",
+                                 n, e)))
+                self._rr.setdefault(name, 0)
+            logger.info(
+                "registered model %s (%d replicas, paged KV%s)", name,
+                len(self._models[name]),
+                f", warm prefix {len(prefix_ids)} tokens"
+                if prefix_ids is not None else "")
+            return
 
         def check_consistent():
             prev = self._prefix_ids[name]
@@ -512,7 +597,9 @@ class Controller:
             _REQUESTS.labels("error").inc()
             raise
         _REQUESTS.labels("ok").inc()
-        _REQ_LATENCY.observe(time.monotonic() - tic)
+        elapsed = time.monotonic() - tic
+        _REQ_LATENCY.observe(elapsed)
+        self._latencies.append(elapsed)
         return {"output_ids": [o.tolist() for o in outs]}
 
     def completions_stream(self, request: Dict[str, Any]):
@@ -558,6 +645,11 @@ class _Handler(BaseHTTPRequestHandler):
         (watchdog gauges, compile-cache collector, ...) is registered
         even when the controller is the only thing this process ran."""
         import alpa_tpu.monitoring  # noqa: F401  pylint: disable=unused-import
+        # the serving-fleet families (alpa_kv_*, alpa_router_*) register
+        # at module import; pull them in so a controller that never built
+        # a pool or router still exposes the series
+        import alpa_tpu.serve.kv_cache  # noqa: F401  pylint: disable=unused-import
+        import alpa_tpu.serve.router  # noqa: F401  pylint: disable=unused-import
         self._send_text(200, _tmetrics.get_registry().to_prometheus_text())
 
     def _healthz(self):
@@ -575,10 +667,12 @@ class _Handler(BaseHTTPRequestHandler):
             state = recovery.state.value
             code = 503 if state == "degraded" else 200
             self._send(code, {"status": state,
-                              "last_flight_dump": _flight.last_dump_path()})
+                              "last_flight_dump": _flight.last_dump_path(),
+                              "load": self.controller.load_report()})
             return
         report = self.controller.health_report()
         report["last_flight_dump"] = _flight.last_dump_path()
+        report["load"] = self.controller.load_report()
         code = 503 if report["status"] == "shedding" else 200
         self._send(code, report)
 

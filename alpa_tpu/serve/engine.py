@@ -103,7 +103,8 @@ class ContinuousBatchingEngine:
                  packed_admission: bool = False,
                  packed_bucket: Optional[int] = None,
                  prefix: Optional[Any] = None,
-                 scheduler: Optional[Any] = None):
+                 scheduler: Optional[Any] = None,
+                 kv_pool: Optional[Any] = None):
         """``packed_admission=True`` admits multiple queued prompts with
         ONE packed prefill (segment-masked, serve.packed.PackedPrefill —
         the 1-D batching analog) instead of one prefill per row; falls
@@ -121,12 +122,46 @@ class ContinuousBatchingEngine:
         ``scheduler``: an admission policy speaking the queue protocol
         (``serve.scheduler``: FIFOQueue default, WeightedFairQueue,
         NestedScheduler).  ``submit(..., queue=name)`` routes requests
-        to named queues; admission order follows the policy."""
+        to named queues; admission order follows the policy.
+
+        ``kv_pool``: a :class:`serve.kv_cache.KVBlockPool` — every
+        admission reserves its block table up front (backpressure
+        instead of over-admission), prompts sharing a cached token
+        prefix skip recomputing those blocks (gather + chunked suffix
+        prefill), and each decode tick scatters the new K/V position
+        into the row's current block.  Decode math still runs on the
+        dense resident caches, so paged output is bit-exact vs unpaged.
+        Mutually exclusive with ``prefix`` (warmed prefixes live in the
+        pool's index instead); disables ``packed_admission``."""
         self.gen = generator
         self.B = max_batch
         self.bucket = prompt_bucket or generator.prompt_buckets[0]
         cfgm = generator.config
         self._prefix = prefix
+        self._pool = kv_pool
+        self._tables: List[Optional[Any]] = [None] * max_batch
+        self._pool_reuse = False
+        if kv_pool is not None:
+            if prefix is not None:
+                raise ValueError(
+                    "kv_pool supersedes the static PrefixHandle: warm "
+                    "system prompts via pool.warm_prefix instead")
+            if kv_pool.seq_len != cfgm.seq_len:
+                raise ValueError(
+                    f"kv_pool seq_len {kv_pool.seq_len} != generator "
+                    f"seq_len {cfgm.seq_len}")
+            self._pool_reuse = (kv_pool.prefix_reuse and
+                                bool(generator.prefill_chunk))
+            if kv_pool.prefix_reuse and not generator.prefill_chunk:
+                logger.warning(
+                    "kv prefix reuse needs Generator(prefill_chunk=...) "
+                    "to prefill suffixes from the match offset; paging "
+                    "stays on but every admission recomputes its prompt")
+            if packed_admission:
+                logger.warning(
+                    "packed_admission is not block-aware; using per-row "
+                    "prefill with the KV pool")
+                packed_admission = False
         if prefix is not None:
             if not generator.prefill_chunk:
                 raise ValueError(
@@ -260,6 +295,13 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefix {plen} + prompt {len(prompt)} + max_new_tokens "
                 f"{cfg.max_new_tokens} exceeds seq_len {seq_len}")
+        if self._pool is not None and not self._pool.fits(
+                len(prompt) + cfg.max_new_tokens):
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new_tokens "
+                f"{cfg.max_new_tokens} needs more KV blocks than the "
+                f"pool holds ({self._pool.num_blocks} x "
+                f"{self._pool.block_size} tokens)")
         if self._prefix is not None:
             # admission prefills in fixed chunks FROM the prefix offset:
             # reject synchronously what chunk padding cannot fit
@@ -346,12 +388,51 @@ class ContinuousBatchingEngine:
                 # not enough for a pack: put back and fall through
                 self._queue.pushback(take)
         for r in range(self.B):
-            if self._active[r] or next_live() is None:
+            if self._active[r]:
                 continue
+            nxt = next_live()
+            if nxt is None:
+                continue
+            seq = None
+            if self._pool is not None:
+                try:
+                    seq = self._pool.begin_sequence(
+                        nxt["prompt"], nxt["cfg"].max_new_tokens)
+                except Exception as e:  # pylint: disable=broad-except
+                    self._queue.popleft()
+                    nxt["error"] = e
+                    nxt["done"].set()
+                    continue
+                if seq is None:
+                    # pool backpressure: live sequences hold the blocks.
+                    # Leave the request queued; a retirement frees blocks
+                    # and the next tick re-admits.  With NO live rows the
+                    # pool can only be out of evictable blocks — fits()
+                    # was checked at submit, so fail loudly instead of
+                    # spinning.
+                    if not self._active.any():
+                        from alpa_tpu.serve.kv_cache import \
+                            KVPoolExhaustedError
+                        item = self._queue.popleft()
+                        item["error"] = KVPoolExhaustedError(
+                            "KV pool exhausted with no live sequences "
+                            "to wait on")
+                        item["done"].set()
+                    break
             item = self._queue.popleft()
             try:
                 p = item["prompt"]
-                if self._prefix is not None:
+                if seq is not None and seq.matched_tokens:
+                    # prefix-reuse hit: gather the cached blocks into a
+                    # dense row and prefill ONLY the suffix from the
+                    # match offset (gather moves bits unchanged; the
+                    # chunk step masks exactly, so this stays bit-exact)
+                    m = seq.matched_tokens
+                    total = jnp.asarray([len(p)], jnp.int32)
+                    gathered = self._pool.gather_dense(seq)
+                    logits1, caches1 = self.gen._run_chunked_prefill(
+                        [p[m:]], total, 1, caches=gathered, start=m)
+                elif self._prefix is not None:
                     # suffix-only prefill OVER the shared prefix K/V.
                     # The handle's arrays are shared read-only: the
                     # chunk step is functional and non-donating, so the
@@ -371,14 +452,45 @@ class ContinuousBatchingEngine:
                 self._caches, self._logits = self._scatter_row(
                     self._caches, caches1, self._logits,
                     logits1.astype(jnp.float32), r)
+                if seq is not None:
+                    # publish the prompt's full blocks while the row is
+                    # still live, so concurrent shared-prefix requests
+                    # hit immediately
+                    self._pool.scatter_prompt(seq, caches1)
+                    if self._pool_reuse:
+                        self._pool.register_prompt(seq, p)
+                    self._tables[r] = seq
                 self._rows[r] = item
                 self._active[r] = True
                 self.admissions += 1
                 _ADMISSIONS.inc()
             except Exception as e:  # pylint: disable=broad-except
                 logger.exception("row admission failed")
+                if seq is not None:
+                    self._pool.release(seq, register=False)
                 item["error"] = e
                 item["done"].set()
+
+    def _release_table(self, r: int, item: Optional[dict]):
+        """Return row ``r``'s blocks to the pool.  A cleanly finished
+        request first publishes its full prompt+output blocks to the
+        prefix index ("recently finished" reuse, incl. multi-turn);
+        cancelled/errored rows just free."""
+        if self._pool is None or self._tables[r] is None:
+            return
+        seq = self._tables[r]
+        self._tables[r] = None
+        try:
+            clean = (item is not None and item["error"] is None and
+                     not item.get("cancelled"))
+            toks = None
+            if clean and self._pool_reuse:
+                toks = np.concatenate(
+                    [item["prompt"],
+                     np.asarray(item["tokens"], np.int32)])
+            self._pool.release(seq, tokens=toks, register=toks is not None)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("KV pool release failed for row %d", r)
 
     def _run(self):
         while True:
@@ -395,6 +507,7 @@ class ContinuousBatchingEngine:
                     for r in range(self.B):
                         if self._active[r]:
                             self._rows[r]["error"] = err
+                            self._release_table(r, self._rows[r])
                             self._rows[r]["done"].set()
                             self._active[r] = False
                             self._rows[r] = None
@@ -417,6 +530,7 @@ class ContinuousBatchingEngine:
                     for r in range(self.B):
                         if self._active[r]:
                             self._rows[r]["error"] = e
+                            self._release_table(r, self._rows[r])
                             self._rows[r]["done"].set()
                             self._active[r] = False
                             self._rows[r] = None
@@ -449,6 +563,12 @@ class ContinuousBatchingEngine:
         self._logits = logits.astype(jnp.float32)
         self.decode_steps += 1
         _DECODE_STEPS.inc()
+        if self._pool is not None:
+            # the tick wrote each row's new K/V at its pre-decode index;
+            # mirror those positions into the block pool (rows without a
+            # table land in the scratch block)
+            self._pool.write_tokens(self._caches, list(self._tables),
+                                    np.asarray(index))
 
         with self._cv:
             for r in range(self.B):
@@ -470,6 +590,7 @@ class ContinuousBatchingEngine:
                            t == cfg.eos_token_id)
                 if (hit_eos or item.get("cancelled") or
                         len(item["tokens"]) >= cfg.max_new_tokens):
+                    self._release_table(r, item)
                     item["done"].set()
                     self._active[r] = False
                     self._rows[r] = None

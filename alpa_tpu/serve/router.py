@@ -1,0 +1,665 @@
+"""Load-aware multi-replica router (ISSUE 11).
+
+One :class:`Router` spreads admission across N serving replicas — each a
+:class:`~alpa_tpu.serve.controller.Controller` in this process
+(:class:`LocalReplicaHandle`) or a remote controller reached over HTTP
+(:class:`HTTPReplicaHandle`).  Placement uses the PR 5 load signals
+(queue depth, request p99, tokens in flight — ``Controller.
+load_report``, also exported on every controller's ``/healthz``):
+
+* ``least_loaded`` (default) scores each routable replica and picks the
+  lightest; ``round_robin`` rotates.  Policy knob: ``router_policy``.
+* Load shedding is PER-REPLICA: a saturated replica (queue depth or p99
+  over the ``router_shed_*`` knobs) is routed around, and a 503
+  (:class:`~alpa_tpu.fault.ServiceDegradedError`) reaches the client
+  only when every healthy replica is saturated or sheds.
+* Replicas whose ``/healthz`` fails ``router_health_fail_threshold``
+  consecutive probes are dropped from rotation; one clean probe
+  restores them (vs the RecoveryManager, which degrades ONE backend —
+  the router degrades the fleet view; docs/fault_tolerance.md).
+* :meth:`Router.rolling_reload` performs a rolling deploy: drain one
+  replica at a time (stop placing, wait out router-tracked in-flight
+  work), reload it through the existing ``/admin/reload`` hot-swap
+  barrier, re-probe, restore — with >= 2 replicas, traffic never sees
+  an error.
+* Autoscale hooks: sustained aggregate load above/below the
+  ``router_autoscale_*`` thresholds fires ``on_want_more`` /
+  ``on_want_fewer`` callbacks (the operator's scale signal; the router
+  itself never creates replicas).
+
+:class:`RouterServer` puts the same router behind HTTP (``/completions``
+incl. SSE on local replicas, ``/healthz`` with the per-replica view,
+``/metrics``, ``POST /admin/rolling_reload``).
+"""
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from alpa_tpu import fault
+from alpa_tpu.global_env import global_config
+from alpa_tpu.telemetry import metrics as _tmetrics
+
+logger = logging.getLogger(__name__)
+
+_REG = _tmetrics.get_registry()
+_ROUTER_REQS = _REG.counter(
+    "alpa_router_requests_total",
+    "Requests routed, by replica and outcome",
+    labelnames=("replica", "outcome"))
+_ROUTER_QDEPTH = _REG.gauge(
+    "alpa_router_replica_queue_depth",
+    "Last observed queue depth per replica",
+    labelnames=("replica",))
+
+
+class LocalReplicaHandle:
+    """In-process replica: a Controller (one or more model replicas of
+    its own — the router treats the whole controller as one unit)."""
+
+    def __init__(self, controller, model: Optional[str] = None):
+        self.controller = controller
+        self.model = model
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.controller.completions(request)
+
+    def completions_stream(self, request: Dict[str, Any]):
+        return self.controller.completions_stream(request)
+
+    def healthz(self):
+        report = self.controller.health_report()
+        recovery = self.controller._recovery
+        if recovery is not None:
+            report["status"] = recovery.state.value
+            code = 503 if report["status"] == "degraded" else 200
+        else:
+            code = 503 if report["status"] == "shedding" else 200
+        report["load"] = self.controller.load_report()
+        return code, report
+
+    def load(self) -> Dict[str, Any]:
+        return self.controller.load_report()
+
+    def reload(self, model: str, ckpt_dir: str,
+               step: Optional[int] = None) -> Dict[str, Any]:
+        return self.controller.reload_model(model, ckpt_dir, step=step)
+
+
+class HTTPReplicaHandle:
+    """Remote replica behind ``http://host:port`` (a running
+    ControllerServer).  Load signals ride the ``/healthz`` body."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _get(self, path: str):
+        try:
+            with urllib.request.urlopen(self.base_url + path,
+                                        timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:  # pylint: disable=broad-except
+                return e.code, {}
+
+    def _post(self, path: str, payload: Dict[str, Any]):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except Exception:  # pylint: disable=broad-except
+                return e.code, {}
+
+    def completions(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        code, body = self._post("/completions", request)
+        if code == 503:
+            raise fault.ServiceDegradedError(
+                body.get("error", "replica shedding"))
+        if code != 200:
+            raise RuntimeError(
+                f"replica {self.base_url} returned {code}: "
+                f"{body.get('error')}")
+        return body
+
+    def completions_stream(self, request: Dict[str, Any]):
+        raise NotImplementedError(
+            "SSE pass-through is only wired for local replicas; point "
+            "streaming clients at the replica controller directly")
+
+    def healthz(self):
+        return self._get("/healthz")
+
+    def load(self) -> Dict[str, Any]:
+        code, body = self._get("/healthz")
+        if code not in (200, 503):
+            raise RuntimeError(f"healthz returned {code}")
+        return body.get("load", {})
+
+    def reload(self, model: str, ckpt_dir: str,
+               step: Optional[int] = None) -> Dict[str, Any]:
+        payload = {"model": model, "ckpt_dir": ckpt_dir}
+        if step is not None:
+            payload["step"] = step
+        code, body = self._post("/admin/reload", payload)
+        if code != 200:
+            raise RuntimeError(f"reload failed ({code}): {body}")
+        return body
+
+
+class _ReplicaState:
+    __slots__ = ("name", "handle", "healthy", "draining", "fails",
+                 "inflight", "last_load", "latencies")
+
+    def __init__(self, name: str, handle):
+        self.name = name
+        self.handle = handle
+        self.healthy = True
+        self.draining = False
+        self.fails = 0
+        self.inflight = 0
+        self.last_load: Dict[str, Any] = {}
+        self.latencies = deque(maxlen=256)
+
+    def view(self) -> Dict[str, Any]:
+        lat = sorted(self.latencies)
+        return {"healthy": self.healthy, "draining": self.draining,
+                "inflight": self.inflight,
+                "consecutive_failures": self.fails,
+                "queue_depth": self.last_load.get("queue_depth"),
+                "tokens_in_flight":
+                    self.last_load.get("tokens_in_flight"),
+                "ttft_p99_ms": self.last_load.get("ttft_p99_ms"),
+                "router_p99_ms":
+                    lat[int(0.99 * (len(lat) - 1))] * 1e3 if lat
+                    else None}
+
+
+class _RoutedStream:
+    """Wraps a replica's token stream so the router's in-flight count
+    (what rolling_reload drains on) covers streams end to end."""
+
+    def __init__(self, inner, on_end: Callable[[], None]):
+        self._inner = inner
+        self._on_end = on_end
+        self._ended = False
+
+    def _end(self):
+        if not self._ended:
+            self._ended = True
+            self._on_end()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self._inner)
+        except BaseException:
+            self._end()
+            raise
+
+    def close(self):
+        try:
+            self._inner.close()
+        finally:
+            self._end()
+
+
+class Router:
+    """Spread admission across replicas; see the module docstring."""
+
+    def __init__(self, policy: Optional[str] = None,
+                 shed_queue_depth: Optional[int] = None,
+                 shed_ttft_ms: Optional[float] = None,
+                 health_fail_threshold: Optional[int] = None,
+                 autoscale_window_s: Optional[float] = None,
+                 autoscale_hi_queue: Optional[float] = None,
+                 autoscale_lo_queue: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.policy = policy or global_config.router_policy
+        if self.policy not in ("least_loaded", "round_robin"):
+            raise ValueError(f"unknown router_policy {self.policy!r}")
+        self.shed_queue_depth = (global_config.router_shed_queue_depth
+                                 if shed_queue_depth is None
+                                 else shed_queue_depth)
+        self.shed_ttft_ms = (global_config.router_shed_ttft_ms
+                             if shed_ttft_ms is None else shed_ttft_ms)
+        self.health_fail_threshold = (
+            global_config.router_health_fail_threshold
+            if health_fail_threshold is None else health_fail_threshold)
+        self.autoscale_window_s = (
+            global_config.router_autoscale_window_s
+            if autoscale_window_s is None else autoscale_window_s)
+        self.autoscale_hi_queue = (
+            global_config.router_autoscale_hi_queue
+            if autoscale_hi_queue is None else autoscale_hi_queue)
+        self.autoscale_lo_queue = (
+            global_config.router_autoscale_lo_queue
+            if autoscale_lo_queue is None else autoscale_lo_queue)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._replicas: "Dict[str, _ReplicaState]" = {}
+        self._rr = 0
+        #: autoscale callbacks — called with (router, mean_depth)
+        self.on_want_more: Optional[Callable] = None
+        self.on_want_fewer: Optional[Callable] = None
+        self.want_more_signals = 0
+        self.want_fewer_signals = 0
+        self._as_samples: "deque" = deque()
+        self._as_last_fire = -float("inf")
+        self.sheds = 0
+
+    # ---- membership -------------------------------------------------
+
+    def add_replica(self, name: str, handle) -> None:
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"replica {name!r} already registered")
+            self._replicas[name] = _ReplicaState(name, handle)
+        logger.info("router: added replica %s", name)
+
+    def remove_replica(self, name: str) -> None:
+        with self._lock:
+            self._replicas.pop(name, None)
+        logger.info("router: removed replica %s", name)
+
+    def replica_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._replicas)
+
+    # ---- health probing ---------------------------------------------
+
+    def probe(self) -> Dict[str, bool]:
+        """Probe every replica's ``/healthz`` once, updating rotation
+        membership (call periodically, or from a prober thread)."""
+        with self._lock:
+            states = list(self._replicas.values())
+        out = {}
+        for st in states:
+            try:
+                code, _body = st.handle.healthz()
+                ok = code == 200
+            except Exception:  # pylint: disable=broad-except
+                ok = False
+            if ok:
+                st.fails = 0
+                if not st.healthy:
+                    logger.info("router: replica %s recovered", st.name)
+                st.healthy = True
+            else:
+                st.fails += 1
+                if (st.healthy and
+                        st.fails >= self.health_fail_threshold):
+                    logger.warning(
+                        "router: replica %s dropped after %d failed "
+                        "probes", st.name, st.fails)
+                    st.healthy = False
+            out[st.name] = st.healthy
+        return out
+
+    # ---- placement --------------------------------------------------
+
+    def _refresh_load(self, st: _ReplicaState) -> None:
+        try:
+            st.last_load = st.handle.load() or {}
+        except Exception:  # pylint: disable=broad-except
+            st.last_load = {}
+        qd = st.last_load.get("queue_depth")
+        if qd is not None:
+            _ROUTER_QDEPTH.labels(st.name).set(int(qd))
+
+    def _saturated(self, st: _ReplicaState) -> bool:
+        qd = st.last_load.get("queue_depth") or 0
+        if self.shed_queue_depth and \
+                qd + st.inflight > self.shed_queue_depth:
+            return True
+        p99 = st.last_load.get("ttft_p99_ms")
+        if self.shed_ttft_ms and p99 is not None and \
+                p99 > self.shed_ttft_ms:
+            return True
+        return False
+
+    def _score(self, st: _ReplicaState) -> float:
+        load = st.last_load
+        return (2.0 * (load.get("queue_depth") or 0) +
+                2.0 * st.inflight +
+                0.01 * (load.get("tokens_in_flight") or 0) +
+                0.001 * (load.get("ttft_p99_ms") or 0.0))
+
+    def _pick(self, exclude) -> Optional[_ReplicaState]:
+        with self._lock:
+            cands = [st for st in self._replicas.values()
+                     if st.healthy and not st.draining
+                     and st.name not in exclude]
+        for st in cands:
+            self._refresh_load(st)
+        cands = [st for st in cands if not self._saturated(st)]
+        if not cands:
+            return None
+        if self.policy == "round_robin":
+            with self._lock:
+                self._rr += 1
+                return cands[self._rr % len(cands)]
+        return min(cands, key=self._score)
+
+    # ---- request paths ----------------------------------------------
+
+    def submit(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Route one completion request, failing over across replicas.
+        Raises ServiceDegradedError (HTTP 503) only when no routable
+        replica remains un-saturated."""
+        excluded: set = set()
+        self._observe_autoscale()
+        while True:
+            st = self._pick(excluded)
+            if st is None:
+                self.sheds += 1
+                _ROUTER_REQS.labels("none", "shed").inc()
+                raise fault.ServiceDegradedError(
+                    "no replica can take the request (all saturated, "
+                    "draining, or unhealthy)")
+            with self._lock:
+                st.inflight += 1
+            tic = self._clock()
+            try:
+                out = st.handle.completions(request)
+            except fault.ServiceDegradedError:
+                # THIS replica sheds; others may still have room
+                _ROUTER_REQS.labels(st.name, "shed").inc()
+                excluded.add(st.name)
+                continue
+            except (OSError, urllib.error.URLError) as e:
+                # transport-level failure: count toward health, fail over
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                with self._lock:
+                    st.fails += 1
+                    if st.fails >= self.health_fail_threshold:
+                        st.healthy = False
+                logger.warning("router: replica %s errored (%s); "
+                               "failing over", st.name, e)
+                excluded.add(st.name)
+                continue
+            except Exception:
+                # request-level error (bad model, bad payload): the
+                # client's fault — do not burn other replicas on it
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                raise
+            finally:
+                with self._lock:
+                    st.inflight -= 1
+            st.fails = 0
+            st.latencies.append(self._clock() - tic)
+            _ROUTER_REQS.labels(st.name, "ok").inc()
+            return out
+
+    def submit_stream(self, request: Dict[str, Any]):
+        """Route a streaming request (local replicas only).  The stream
+        counts as in-flight until exhausted or closed, so rolling
+        deploys drain it before touching its replica."""
+        self._observe_autoscale()
+        st = self._pick(set())
+        if st is None:
+            self.sheds += 1
+            _ROUTER_REQS.labels("none", "shed").inc()
+            raise fault.ServiceDegradedError(
+                "no replica can take the stream")
+        with self._lock:
+            st.inflight += 1
+        try:
+            inner = st.handle.completions_stream(request)
+        except BaseException:
+            with self._lock:
+                st.inflight -= 1
+            _ROUTER_REQS.labels(st.name, "error").inc()
+            raise
+        _ROUTER_REQS.labels(st.name, "ok").inc()
+
+        def on_end():
+            with self._lock:
+                st.inflight -= 1
+        return _RoutedStream(inner, on_end)
+
+    # ---- rolling deploys --------------------------------------------
+
+    def rolling_reload(self, model: str, ckpt_dir: str,
+                       step: Optional[int] = None,
+                       drain_timeout: float = 30.0) -> List[Dict]:
+        """Hot-swap ``model`` on every replica, ONE replica at a time:
+        stop placing on it, wait out its router-tracked in-flight work,
+        reload through the replica's ``/admin/reload`` drain barrier,
+        re-probe, restore.  With >= 2 replicas traffic keeps flowing the
+        whole time (zero failed requests — pinned in
+        tests/serve/test_router.py)."""
+        with self._lock:
+            names = sorted(self._replicas)
+        if len(names) < 2:
+            logger.warning(
+                "rolling reload over %d replica(s): requests arriving "
+                "mid-swap will shed", len(names))
+        results = []
+        for name in names:
+            with self._lock:
+                st = self._replicas.get(name)
+            if st is None:
+                continue
+            st.draining = True
+            try:
+                deadline = self._clock() + drain_timeout
+                while st.inflight > 0 and self._clock() < deadline:
+                    time.sleep(0.005)
+                if st.inflight > 0:
+                    logger.warning(
+                        "replica %s still has %d in-flight after "
+                        "%.0fs; its own drain barrier takes over",
+                        name, st.inflight, drain_timeout)
+                res = st.handle.reload(model, ckpt_dir, step=step)
+                code, _ = st.handle.healthz()
+                if code != 200:
+                    st.fails = self.health_fail_threshold
+                    st.healthy = False
+                    raise RuntimeError(
+                        f"replica {name} unhealthy after reload "
+                        f"(healthz {code})")
+                results.append({"replica": name, **res})
+            finally:
+                st.draining = False
+        return results
+
+    # ---- autoscale hooks --------------------------------------------
+
+    def _observe_autoscale(self) -> None:
+        with self._lock:
+            states = [st for st in self._replicas.values() if st.healthy]
+            n = max(1, len(states))
+            depth = sum((st.last_load.get("queue_depth") or 0) +
+                        st.inflight for st in states) / n
+        now = self._clock()
+        self._as_samples.append((now, depth))
+        self.evaluate_autoscale(now)
+
+    def evaluate_autoscale(self, now: Optional[float] = None) -> Optional[str]:
+        """Fire ``on_want_more`` when the mean per-replica queue depth
+        stayed above ``router_autoscale_hi_queue`` for a full window,
+        ``on_want_fewer`` when it stayed below the lo threshold.  At
+        most one signal per window.  Returns the signal fired (or
+        None) so pollers can act without registering callbacks."""
+        now = self._clock() if now is None else now
+        w = self.autoscale_window_s
+        while self._as_samples and self._as_samples[0][0] < now - 2 * w:
+            self._as_samples.popleft()
+        window = [d for (t, d) in self._as_samples if t >= now - w]
+        if len(window) < 2 or not self._as_samples or \
+                self._as_samples[0][0] > now - w:
+            return None  # window not yet covered
+        if now - self._as_last_fire < w:
+            return None  # rate limit: one signal per window
+        signal = None
+        if min(window) > self.autoscale_hi_queue:
+            signal = "want_more"
+            self.want_more_signals += 1
+            cb = self.on_want_more
+        elif max(window) < self.autoscale_lo_queue:
+            signal = "want_fewer"
+            self.want_fewer_signals += 1
+            cb = self.on_want_fewer
+        else:
+            return None
+        self._as_last_fire = now
+        mean = sum(window) / len(window)
+        logger.info("router autoscale: %s (mean depth %.1f over %.0fs)",
+                    signal, mean, w)
+        if cb is not None:
+            try:
+                cb(self, mean)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception("autoscale callback failed")
+        return signal
+
+    # ---- introspection ----------------------------------------------
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Per-replica health/load view (the ``/healthz`` body of
+        RouterServer)."""
+        with self._lock:
+            states = list(self._replicas.values())
+        routable = [st for st in states
+                    if st.healthy and not st.draining]
+        return {"status": "ok" if routable else "degraded",
+                "policy": self.policy,
+                "replicas": {st.name: st.view() for st in states},
+                "sheds": self.sheds,
+                "want_more_signals": self.want_more_signals,
+                "want_fewer_signals": self.want_fewer_signals}
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    router: Router = None  # set by RouterServer
+
+    def log_message(self, fmt, *args):  # quiet
+        logger.debug(fmt, *args)
+
+    def _send(self, code: int, payload: Dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/healthz":
+            snap = self.router.snapshot()
+            self._send(503 if snap["status"] == "degraded" else 200,
+                       snap)
+        elif self.path == "/metrics":
+            import alpa_tpu.monitoring  # noqa: F401  pylint: disable=unused-import
+            import alpa_tpu.serve.kv_cache  # noqa: F401  pylint: disable=unused-import
+            text = _tmetrics.get_registry().to_prometheus_text()
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._send(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            request = json.loads(self.rfile.read(length) or b"{}")
+            if self.path == "/admin/rolling_reload":
+                name = request.get("model")
+                ckpt_dir = request.get("ckpt_dir")
+                if not name or not ckpt_dir:
+                    raise ValueError(
+                        "rolling_reload needs 'model' and 'ckpt_dir'")
+                step = request.get("step")
+                out = self.router.rolling_reload(
+                    name, ckpt_dir,
+                    step=None if step is None else int(step))
+                self._send(200, {"reloads": out})
+                return
+            if self.path != "/completions":
+                self._send(404, {"error": f"unknown path {self.path}"})
+                return
+            if request.get("stream"):
+                self._stream(request)
+                return
+            self._send(200, self.router.submit(request))
+        except fault.ServiceDegradedError as e:
+            self._send(503, {"error": str(e)})
+        except KeyError as e:
+            self._send(404, {"error": str(e)})
+        except (json.JSONDecodeError, ValueError, TypeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception("router request failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _stream(self, request):
+        it = self.router.submit_stream(request)  # validates/places
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            try:
+                for t in it:
+                    self.wfile.write(
+                        f"data: {json.dumps({'token': t})}\n\n".encode())
+                    self.wfile.flush()
+                final = {"done": True}
+            except (BrokenPipeError, ConnectionResetError):
+                it.close()
+                return
+            except Exception as e:  # pylint: disable=broad-except
+                logger.exception("routed stream failed mid-generation")
+                final = {"error": f"{type(e).__name__}: {e}"}
+            self.wfile.write(f"data: {json.dumps(final)}\n\n".encode())
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            it.close()
+        finally:
+            self.close_connection = True
+
+
+class RouterServer:
+    """HTTP front end over a Router (mirror of ControllerServer)."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        handler = type("BoundRouterHandler", (_RouterHandler,),
+                       {"router": router})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.router = router
+        self.thread = threading.Thread(target=self.httpd.serve_forever,
+                                       daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self):
+        self.thread.start()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

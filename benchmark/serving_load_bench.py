@@ -1,0 +1,442 @@
+"""Fleet-serving load bench (ISSUE 11): open-loop generator driving the
+paged-KV engine and the multi-replica router.
+
+Three scenarios, one committed artifact
+(``benchmark/results/serving_load.json``):
+
+* ``reuse``   — Poisson arrivals with a shared-prefix / unique-prompt
+  mix against (a) the unpaged engine and (b) the paged engine with
+  cross-request prefix reuse; records TTFT p50/p99 per engine, output
+  tokens/s, and the pool's prefix-hit rate.  The shared-prefix
+  population reuses a long common prefix, so the paged TTFT p99 should
+  beat unpaged (the hit path prefills only the suffix).
+* ``router``  — 2 local replicas, one artificially degraded (slowed);
+  drives the same trace through ``least_loaded`` and ``round_robin``
+  and records per-policy p99 plus failure counts (pinned 0), then a
+  burst against a tiny shed threshold to measure shed-then-admit.
+* ``rolling`` — hammer 2 replicas while ``Router.rolling_reload`` swaps
+  weights one replica at a time; records completed requests and
+  failures (pinned 0).
+
+    python benchmark/serving_load_bench.py [--requests 32] [--seed 0]
+        [--out benchmark/results/serving_load.json] [--gate]
+
+``--gate`` flattens the scenario metrics under ``serving.*`` and checks
+them against ``benchmark/results/perf_gate_baseline.json``
+(``benchmark/perf_gate.py``).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results",
+                           "serving_load.json")
+
+
+def _tiny_generator(seq_len=128, prefill_chunk=16, hidden=64, layers=2):
+    from alpa_tpu.model.gpt_model import GPTConfig, init_gpt_real
+    from alpa_tpu.serve.generation import Generator
+    cfg = GPTConfig(hidden_size=hidden, num_layers=layers, num_heads=4,
+                    seq_len=seq_len, vocab_size=256)
+    model, params = init_gpt_real(cfg, 1)
+    return (Generator(model, params, cfg, prefill_chunk=prefill_chunk),
+            model, params, cfg)
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.999))]
+
+
+def make_trace(n, rng, shared_frac=0.6, prefix_len=192, suffix_len=12,
+               rate_hz=4.0):
+    """Open-loop request trace: Poisson arrival offsets, with
+    ``shared_frac`` of prompts sharing one long prefix."""
+    prefix = rng.randint(2, 250, size=prefix_len).astype(np.int32)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    reqs = []
+    for i in range(n):
+        suffix = rng.randint(2, 250, size=suffix_len).astype(np.int32)
+        shared = bool(rng.random() < shared_frac)
+        if shared:
+            prompt = np.concatenate([prefix, suffix])
+        else:
+            prompt = rng.randint(2, 250,
+                                 size=prefix_len + suffix_len
+                                 ).astype(np.int32)
+        reqs.append((float(arrivals[i]), prompt, shared))
+    return reqs
+
+
+def _drive_engine(engine, trace, max_new):
+    """Replay the trace open-loop; returns per-request
+    (is_shared, ttft_s) samples plus errors and wall time."""
+    from alpa_tpu.serve.generation import GenerationConfig
+    cfg = GenerationConfig(max_new_tokens=max_new, temperature=0.0)
+    samples, errors = [], []
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def run(arrival, prompt, shared):
+        wait = arrival - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        sent = time.perf_counter()
+        try:
+            it = engine.submit_stream(prompt, cfg)
+            first = None
+            for _ in it:
+                if first is None:
+                    first = time.perf_counter() - sent
+            with lock:
+                samples.append((shared, first))
+        except Exception as e:  # pylint: disable=broad-except
+            with lock:
+                errors.append(repr(e))
+
+    threads = [threading.Thread(target=run, args=(a, p, s))
+               for a, p, s in trace]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return samples, errors, wall
+
+
+def bench_reuse(n_requests, seed, max_new=8):
+    from alpa_tpu.serve.engine import ContinuousBatchingEngine
+    from alpa_tpu.serve.kv_cache import KVBlockPool
+
+    from alpa_tpu.serve.generation import GenerationConfig
+
+    rng = np.random.RandomState(seed)
+    trace = make_trace(n_requests, rng)
+    out = {}
+    for mode in ("unpaged", "paged"):
+        # prefill-heavy model: long prompts so TTFT is dominated by the
+        # work prefix reuse removes
+        gen, _m, _p, _c = _tiny_generator(seq_len=256, prefill_chunk=32,
+                                          hidden=256, layers=4)
+        pool = None
+        if mode == "paged":
+            # sized so the whole trace's chains stay cached: partial
+            # evictions would shift the suffix-prefill start offset and
+            # recompile mid-run, polluting the TTFT percentiles
+            pool = KVBlockPool.for_generator(gen, max_batch=4,
+                                             block_size=16,
+                                             num_blocks=640,
+                                             prefix_reuse=True)
+        engine = ContinuousBatchingEngine(
+            gen, max_batch=4, prompt_bucket=gen.prompt_buckets[-1],
+            kv_pool=pool)
+        # warm ALL compile paths outside the measured window: the miss
+        # (bucketed) path, and — for the paged engine — the reuse-hit
+        # path (gather + chunked suffix prefill + scatter), by sending
+        # a same-shape warmup prompt twice
+        warm = np.concatenate([trace[0][1][:-1],
+                               np.array([255], np.int32)])
+        wcfg = GenerationConfig(max_new_tokens=2, temperature=0.0)
+        engine.submit(warm, wcfg)
+        engine.submit(warm, wcfg)
+        warm_stats = pool.stats() if pool is not None else {}
+        samples, errors, wall = _drive_engine(engine, trace, max_new)
+        stats = pool.stats() if pool is not None else {}
+        engine.shutdown()
+        ttfts = [t for _s, t in samples]
+        shared_ttfts = [t for s, t in samples if s]
+        out[mode] = {
+            "requests_ok": len(ttfts),
+            "errors": len(errors),
+            "ttft_p50_ms": round(_percentile(ttfts, 0.5) * 1e3, 2),
+            "ttft_p99_ms": round(_percentile(ttfts, 0.99) * 1e3, 2),
+            "shared_ttft_p50_ms": round(
+                _percentile(shared_ttfts, 0.5) * 1e3, 2),
+            "shared_ttft_p99_ms": round(
+                _percentile(shared_ttfts, 0.99) * 1e3, 2),
+            "tokens_per_s": round(len(ttfts) * max_new / wall, 1),
+            "wall_s": round(wall, 2),
+        }
+        if pool is not None:
+            hits = stats["prefix_hits"] - warm_stats["prefix_hits"]
+            out[mode]["prefix_hit_rate"] = round(
+                hits / max(1, len(trace)), 3)
+            out[mode]["bytes_saved"] = (stats["bytes_saved"]
+                                        - warm_stats["bytes_saved"])
+    # the reuse win shows on the shared-prefix population (paged hits
+    # prefill only the suffix; unpaged prefills the full prompt); the
+    # perf gate pins the p50 ratio — p99 over ~20 samples is one
+    # scheduler hiccup away from an outlier
+    out["reuse_ttft_p99_ratio"] = round(
+        out["paged"]["shared_ttft_p99_ms"]
+        / out["unpaged"]["shared_ttft_p99_ms"], 3)
+    out["reuse_ttft_p50_ratio"] = round(
+        out["paged"]["shared_ttft_p50_ms"]
+        / out["unpaged"]["shared_ttft_p50_ms"], 3)
+    return out
+
+
+class _SlowHandle:
+    """Degrades a replica: inflates its reported queue depth and slows
+    every request (a busy / unhealthy box the router should avoid)."""
+
+    def __init__(self, inner, delay_s=0.5, fake_depth=40):
+        self.inner = inner
+        self.delay_s = delay_s
+        self.fake_depth = fake_depth
+
+    def completions(self, request):
+        time.sleep(self.delay_s)
+        return self.inner.completions(request)
+
+    def completions_stream(self, request):
+        time.sleep(self.delay_s)
+        return self.inner.completions_stream(request)
+
+    def healthz(self):
+        return self.inner.healthz()
+
+    def load(self):
+        load = dict(self.inner.load())
+        load["queue_depth"] = (load.get("queue_depth") or 0) \
+            + self.fake_depth
+        return load
+
+    def reload(self, model, ckpt_dir, step=None):
+        return self.inner.reload(model, ckpt_dir, step=step)
+
+
+def _controller_pair():
+    from alpa_tpu.serve.controller import Controller
+    from alpa_tpu.serve.router import LocalReplicaHandle
+    handles = []
+    for _ in range(2):
+        gen, model, params, cfg = _tiny_generator()
+        ctrl = Controller()
+        ctrl.register_model("m", gen)
+        # warm the replica's executables outside the measured window:
+        # the batched path (batch 1) and the streaming engine
+        ctrl.completions({"model": "m", "prompt_ids": [1, 2, 3],
+                          "max_new_tokens": 2})
+        list(ctrl.completions_stream({"model": "m",
+                                      "prompt_ids": [1, 2, 3],
+                                      "max_new_tokens": 2}))
+        handles.append((ctrl, LocalReplicaHandle(ctrl),
+                        (model, params, cfg)))
+    return handles
+
+
+def _drive_router(router, n, rng, max_new=4):
+    """Streamed requests through the router (the continuous-batching
+    engine path: fixed-shape executables, so measured latency is
+    queueing + service, not per-batch-size compiles); returns
+    request-completion latencies and failures."""
+    arrivals = np.cumsum(rng.exponential(1.0 / 2.5, size=n))
+    lats, errors = [], []
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def run(arrival, prompt):
+        wait = arrival - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        sent = time.perf_counter()
+        try:
+            it = router.submit_stream(
+                {"model": "m", "prompt_ids": prompt,
+                 "max_new_tokens": max_new})
+            n_toks = sum(1 for _ in it)
+            assert n_toks == max_new
+            with lock:
+                lats.append(time.perf_counter() - sent)
+        except Exception as e:  # pylint: disable=broad-except
+            with lock:
+                errors.append(repr(e))
+
+    threads = [
+        threading.Thread(
+            target=run,
+            args=(float(arrivals[i]),
+                  rng.randint(2, 250, size=8).tolist()))
+        for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return lats, errors
+
+
+def bench_router(n_requests, seed):
+    from alpa_tpu.serve.router import Router
+
+    out = {}
+    for policy in ("least_loaded", "round_robin"):
+        rng = np.random.RandomState(seed + 1)
+        pairs = _controller_pair()
+        router = Router(policy=policy)
+        router.add_replica("good", pairs[0][1])
+        router.add_replica("slow", _SlowHandle(pairs[1][1]))
+        lats, errors = _drive_router(router, n_requests, rng)
+        out[policy] = {
+            "requests_ok": len(lats),
+            "failures": len(errors),
+            "latency_p50_ms": round(
+                _percentile(lats, 0.5) * 1e3, 2),
+            "latency_p99_ms": round(
+                _percentile(lats, 0.99) * 1e3, 2),
+        }
+    out["degraded_p99_ratio"] = round(
+        out["least_loaded"]["latency_p99_ms"]
+        / out["round_robin"]["latency_p99_ms"], 3)
+
+    # shed-then-admit burst: one replica, tiny threshold, all-at-once
+    from alpa_tpu.serve.router import Router as _R
+    rng = np.random.RandomState(seed + 2)
+    pairs = _controller_pair()
+    router = _R(policy="least_loaded", shed_queue_depth=2)
+    router.add_replica("only", pairs[0][1])
+    ok, shed = [], []
+
+    def burst(i):
+        try:
+            router.submit({"model": "m",
+                           "prompt_ids": rng.randint(2, 250,
+                                                     size=8).tolist(),
+                           "max_new_tokens": 4})
+            ok.append(i)
+        except Exception:  # pylint: disable=broad-except
+            shed.append(i)
+
+    threads = [threading.Thread(target=burst, args=(i,))
+               for i in range(12)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # after the burst clears, the same replica admits again
+    router.submit({"model": "m", "prompt_ids": [1, 2, 3],
+                   "max_new_tokens": 2})
+    out["burst"] = {"admitted": len(ok), "shed": len(shed),
+                    "shed_rate": round(len(shed) / 12.0, 3),
+                    "post_burst_admit": 1}
+    return out
+
+
+def bench_rolling(seed, tmp_dir):
+    import jax
+    from alpa_tpu.checkpoint.manager import CheckpointManager
+    from alpa_tpu.serve.router import Router
+
+    pairs = _controller_pair()
+    _model, params, _cfg = pairs[0][2]
+    new_params = jax.tree_util.tree_map(lambda x: x * 0.5 + 0.25,
+                                        params)
+    ckpt_dir = os.path.join(tmp_dir, "ckpt")
+    ma = CheckpointManager(ckpt_dir, async_save=False)
+    ma.save(1, new_params)
+    ma.wait()
+
+    router = Router(policy="least_loaded")
+    router.add_replica("r0", pairs[0][1])
+    router.add_replica("r1", pairs[1][1])
+
+    rng = np.random.RandomState(seed + 3)
+    outputs, errors = [], []
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                router.submit({"model": "m",
+                               "prompt_ids": rng.randint(
+                                   2, 250, size=8).tolist(),
+                               "max_new_tokens": 4})
+                outputs.append(1)
+            except Exception as e:  # pylint: disable=broad-except
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    time.sleep(0.3)
+    router.rolling_reload("m", ckpt_dir)
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    return {"requests_ok": len(outputs), "failures": len(errors),
+            "deploy_wall_s": round(time.perf_counter() - t0, 2),
+            "replicas": 2}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=DEFAULT_OUT)
+    p.add_argument("--gate", action="store_true",
+                   help="check serving.* metrics against the perf-gate "
+                        "baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite matching baseline values from this run")
+    args = p.parse_args(argv)
+
+    import tempfile
+    print("== reuse (paged prefix reuse vs unpaged) ==", flush=True)
+    reuse = bench_reuse(args.requests, args.seed)
+    print(json.dumps(reuse, indent=1), flush=True)
+    print("== router (1 degraded replica of 2) ==", flush=True)
+    router = bench_router(args.requests, args.seed)
+    print(json.dumps(router, indent=1), flush=True)
+    print("== rolling deploy under load ==", flush=True)
+    with tempfile.TemporaryDirectory() as td:
+        rolling = bench_rolling(args.seed, td)
+    print(json.dumps(rolling, indent=1), flush=True)
+
+    results = {
+        "n_requests": args.requests,
+        "seed": args.seed,
+        "reuse": reuse,
+        "router": router,
+        "rolling": rolling,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+
+    if args.gate or args.update_baseline:
+        from benchmark import perf_gate
+        fresh = perf_gate.flatten_metrics({"serving": {
+            "prefix_hit_rate": reuse["paged"]["prefix_hit_rate"],
+            "reuse_ttft_p50_ratio": reuse["reuse_ttft_p50_ratio"],
+            "degraded_p99_ratio": router["degraded_p99_ratio"],
+            "degraded_failures":
+                router["least_loaded"]["failures"]
+                + router["round_robin"]["failures"],
+            "rolling_failures": rolling["failures"],
+        }})
+        if args.update_baseline:
+            perf_gate._update(fresh, perf_gate.DEFAULT_BASELINE)
+            return 0
+        verdict = perf_gate.gate(fresh)
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            print("SERVING LOAD GATE FAILED", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,415 @@
+"""Multi-replica router (serve/router.py, ISSUE 11): load-aware
+placement, per-replica shedding, health drop/recovery, failover,
+autoscale signals, and the tier-1 pinned zero-error rolling deploy."""
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from alpa_tpu import fault
+from alpa_tpu.checkpoint.manager import CheckpointManager
+from alpa_tpu.global_env import global_config
+from alpa_tpu.model.gpt_model import GPTConfig, init_gpt_real
+from alpa_tpu.serve.controller import Controller
+from alpa_tpu.serve.router import (LocalReplicaHandle, Router,
+                                   RouterServer)
+
+
+def _tiny(**gen_kwargs):
+    from alpa_tpu.serve.generation import Generator
+    cfg = GPTConfig(hidden_size=32, num_layers=2, num_heads=4,
+                    seq_len=32, vocab_size=64)
+    model, params = init_gpt_real(cfg, 1)
+    return Generator(model, params, cfg, **gen_kwargs), model, params, cfg
+
+
+PROMPT = [3, 1, 4, 1, 5]
+REQ = {"model": "m", "prompt_ids": PROMPT, "max_new_tokens": 4}
+
+
+class StubHandle:
+    """Scriptable replica: configurable load report, health, and
+    completion behavior."""
+
+    def __init__(self, load=None, health_code=200, fail_with=None):
+        self.load_report = load or {"queue_depth": 0,
+                                    "tokens_in_flight": 0,
+                                    "ttft_p99_ms": None}
+        self.health_code = health_code
+        self.fail_with = fail_with
+        self.calls = 0
+
+    def completions(self, request):
+        self.calls += 1
+        if self.fail_with is not None:
+            raise self.fail_with
+        return {"output_ids": [request["prompt_ids"] + [0]]}
+
+    def completions_stream(self, request):
+        self.calls += 1
+        if self.fail_with is not None:
+            raise self.fail_with
+        return iter([0, 1])
+
+    def healthz(self):
+        return self.health_code, {"load": self.load_report}
+
+    def load(self):
+        return self.load_report
+
+    def reload(self, model, ckpt_dir, step=None):
+        return {"model": model, "step": step}
+
+
+class TestPlacement:
+
+    def test_least_loaded_prefers_idle_replica(self):
+        r = Router(policy="least_loaded")
+        busy = StubHandle(load={"queue_depth": 10,
+                                "tokens_in_flight": 500,
+                                "ttft_p99_ms": 50.0})
+        idle = StubHandle()
+        r.add_replica("busy", busy)
+        r.add_replica("idle", idle)
+        for _ in range(8):
+            r.submit(dict(REQ))
+        assert idle.calls == 8 and busy.calls == 0
+
+    def test_round_robin_rotates(self):
+        r = Router(policy="round_robin")
+        a, b = StubHandle(), StubHandle()
+        r.add_replica("a", a)
+        r.add_replica("b", b)
+        for _ in range(8):
+            r.submit(dict(REQ))
+        assert a.calls == 4 and b.calls == 4
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError):
+            Router(policy="coin_flip")
+
+
+class TestSheddingAndHealth:
+
+    def test_saturated_replica_routed_around(self):
+        r = Router(policy="least_loaded", shed_queue_depth=4)
+        sat = StubHandle(load={"queue_depth": 50,
+                               "tokens_in_flight": 0,
+                               "ttft_p99_ms": None})
+        ok = StubHandle()
+        r.add_replica("sat", sat)
+        r.add_replica("ok", ok)
+        for _ in range(4):
+            r.submit(dict(REQ))
+        assert ok.calls == 4 and sat.calls == 0
+
+    def test_503_only_when_every_replica_saturated(self):
+        r = Router(policy="least_loaded", shed_queue_depth=4)
+        load = {"queue_depth": 50, "tokens_in_flight": 0,
+                "ttft_p99_ms": None}
+        r.add_replica("a", StubHandle(load=dict(load)))
+        r.add_replica("b", StubHandle(load=dict(load)))
+        with pytest.raises(fault.ServiceDegradedError):
+            r.submit(dict(REQ))
+        assert r.sheds == 1
+
+    def test_shed_then_admit(self):
+        r = Router(policy="least_loaded", shed_queue_depth=4)
+        st = StubHandle(load={"queue_depth": 50, "tokens_in_flight": 0,
+                              "ttft_p99_ms": None})
+        r.add_replica("a", st)
+        with pytest.raises(fault.ServiceDegradedError):
+            r.submit(dict(REQ))
+        st.load_report = {"queue_depth": 0, "tokens_in_flight": 0,
+                          "ttft_p99_ms": None}
+        out = r.submit(dict(REQ))
+        assert out["output_ids"][0][-1] == 0
+
+    def test_replica_shed_fails_over_not_503(self):
+        """A replica raising ServiceDegradedError (its own shedding)
+        only excludes THAT replica."""
+        r = Router(policy="round_robin")
+        shedding = StubHandle(
+            fail_with=fault.ServiceDegradedError("backend down"))
+        ok = StubHandle()
+        r.add_replica("shedding", shedding)
+        r.add_replica("ok", ok)
+        for _ in range(4):
+            r.submit(dict(REQ))
+        assert ok.calls == 4
+
+    def test_degraded_replica_dropped_then_recovers(self):
+        r = Router(health_fail_threshold=3)
+        flaky = StubHandle(health_code=503)
+        ok = StubHandle()
+        r.add_replica("flaky", flaky)
+        r.add_replica("ok", ok)
+        for i in range(3):
+            health = r.probe()
+            # dropped only after the 3rd consecutive failure
+            assert health["flaky"] is (i < 2)
+        snap = r.snapshot()
+        assert snap["replicas"]["flaky"]["healthy"] is False
+        r.submit(dict(REQ))
+        assert ok.calls == 1 and flaky.calls == 0
+        # one clean probe restores
+        flaky.health_code = 200
+        assert r.probe()["flaky"] is True
+        assert r.snapshot()["replicas"]["flaky"]["healthy"] is True
+
+    def test_transport_error_fails_over_and_counts(self):
+        r = Router(health_fail_threshold=1)
+        dead = StubHandle(fail_with=ConnectionRefusedError("down"))
+        ok = StubHandle()
+        r.add_replica("dead", dead)
+        r.add_replica("ok", ok)
+        out = r.submit(dict(REQ))      # fails over transparently
+        assert out["output_ids"][0][-1] == 0
+        assert r.snapshot()["replicas"]["dead"]["healthy"] is False
+
+    def test_request_level_error_propagates(self):
+        """Client mistakes (unknown model, ...) must NOT burn through
+        every replica."""
+        r = Router()
+        bad = StubHandle(fail_with=KeyError("unknown model"))
+        other = StubHandle()
+        r.add_replica("bad", bad)
+        r.add_replica("other", other)
+        hit = 0
+        for _ in range(4):
+            try:
+                r.submit(dict(REQ))
+            except KeyError:
+                hit += 1
+        assert bad.calls + other.calls == 4
+        assert hit == bad.calls          # bad's errors propagated
+
+
+class TestAutoscale:
+
+    def test_sustained_high_fires_want_more_once_per_window(self):
+        now = [1000.0]
+        r = Router(autoscale_window_s=10.0, autoscale_hi_queue=4.0,
+                   autoscale_lo_queue=1.0, clock=lambda: now[0])
+        fired = []
+        r.on_want_more = lambda router, mean: fired.append(mean)
+        for _ in range(12):              # 12 samples over 11s, depth 8
+            r._as_samples.append((now[0], 8.0))
+            assert r.evaluate_autoscale() in (None, "want_more")
+            now[0] += 1.0
+        assert r.want_more_signals == 1
+        assert fired and fired[0] == 8.0
+        # stays high: next signal only after another full window
+        for _ in range(12):
+            r._as_samples.append((now[0], 8.0))
+            r.evaluate_autoscale()
+            now[0] += 1.0
+        assert r.want_more_signals == 2
+
+    def test_sustained_low_fires_want_fewer(self):
+        now = [0.0]
+        r = Router(autoscale_window_s=10.0, autoscale_hi_queue=4.0,
+                   autoscale_lo_queue=1.0, clock=lambda: now[0])
+        for _ in range(12):
+            r._as_samples.append((now[0], 0.2))
+            r.evaluate_autoscale()
+            now[0] += 1.0
+        assert r.want_fewer_signals == 1
+
+    def test_mixed_window_fires_nothing(self):
+        now = [0.0]
+        r = Router(autoscale_window_s=10.0, autoscale_hi_queue=4.0,
+                   autoscale_lo_queue=1.0, clock=lambda: now[0])
+        for i in range(12):
+            r._as_samples.append((now[0], 8.0 if i % 2 else 0.2))
+            r.evaluate_autoscale()
+            now[0] += 1.0
+        assert r.want_more_signals == 0
+        assert r.want_fewer_signals == 0
+
+
+def _save_ckpt(tmp_path, params, step=1):
+    ma = CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    ma.save(step, params)
+    ma.wait()
+    return str(tmp_path / "ckpt")
+
+
+def _two_controller_router(**router_kwargs):
+    ctrls, gens = [], []
+    for _ in range(2):
+        gen, model, params, cfg = _tiny()
+        ctrl = Controller()
+        ctrl.register_model("m", gen)
+        ctrls.append(ctrl)
+        gens.append((model, params, cfg))
+    r = Router(policy="least_loaded", **router_kwargs)
+    r.add_replica("r0", LocalReplicaHandle(ctrls[0]))
+    r.add_replica("r1", LocalReplicaHandle(ctrls[1]))
+    return r, ctrls, gens
+
+
+class TestRollingDeploy:
+    """Tier-1 pinned: rolling reload across 2 live replicas under
+    hammering traffic (batched + streamed) produces ZERO failed
+    requests, and both replicas serve the new weights afterwards."""
+
+    def test_rolling_reload_zero_errors(self, tmp_path):
+        r, ctrls, gens = _two_controller_router()
+        model, params, cfg = gens[0]
+        new_params = jax.tree_util.tree_map(lambda x: x * 0.5 + 0.25,
+                                            params)
+        ckpt_dir = _save_ckpt(tmp_path, new_params)
+
+        errors, outputs, stream_errors = [], [], []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    out = r.submit({"model": "m", "prompt_ids": PROMPT,
+                                    "max_new_tokens": 4})
+                    outputs.append(out["output_ids"][0])
+                except Exception as e:  # pylint: disable=broad-except
+                    errors.append(e)
+                    return
+
+        def hammer_stream():
+            while not stop.is_set():
+                try:
+                    it = r.submit_stream(
+                        {"model": "m", "prompt_ids": PROMPT,
+                         "max_new_tokens": 4})
+                    toks = list(it)
+                    assert len(toks) == 4
+                except Exception as e:  # pylint: disable=broad-except
+                    stream_errors.append(e)
+                    return
+
+        threads = ([threading.Thread(target=hammer) for _ in range(2)]
+                   + [threading.Thread(target=hammer_stream)])
+        for t in threads:
+            t.start()
+        try:
+            time.sleep(0.3)
+            results = r.rolling_reload("m", ckpt_dir)
+            time.sleep(0.3)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+
+        assert not errors, f"batched requests failed: {errors}"
+        assert not stream_errors, f"streams failed: {stream_errors}"
+        assert [res["replica"] for res in results] == ["r0", "r1"]
+        assert outputs
+        # after the deploy BOTH replicas answer with the new weights
+        from alpa_tpu.serve.generation import (GenerationConfig,
+                                               Generator)
+        want_new = np.asarray(Generator(model, new_params, cfg)
+                              .generate(np.array([PROMPT], np.int32),
+                                        GenerationConfig(
+                                            max_new_tokens=4)))[0]
+        for ctrl in ctrls:
+            out = ctrl.completions({"model": "m", "prompt_ids": PROMPT,
+                                    "max_new_tokens": 4})
+            assert out["output_ids"][0] == want_new.tolist()
+
+    def test_draining_replica_not_picked(self):
+        r = Router()
+        a, b = StubHandle(), StubHandle()
+        r.add_replica("a", a)
+        r.add_replica("b", b)
+        r._replicas["a"].draining = True
+        for _ in range(4):
+            r.submit(dict(REQ))
+        assert b.calls == 4 and a.calls == 0
+
+
+class TestRouterServer:
+
+    def test_healthz_metrics_completions(self):
+        gen, _model, _params, _cfg = _tiny()
+        ctrl = Controller()
+        ctrl.register_model("m", gen)
+        r = Router()
+        r.add_replica("r0", LocalReplicaHandle(ctrl))
+        server = RouterServer(r, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with urllib.request.urlopen(base + "/healthz") as resp:
+                body = json.loads(resp.read())
+                assert resp.status == 200
+            assert body["status"] == "ok"
+            assert body["replicas"]["r0"]["healthy"] is True
+
+            req = urllib.request.Request(
+                base + "/completions",
+                data=json.dumps(REQ).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                out = json.loads(resp.read())
+            assert len(out["output_ids"][0]) == len(PROMPT) + 4
+
+            with urllib.request.urlopen(base + "/metrics") as resp:
+                text = resp.read().decode()
+            for family in ("alpa_router_requests_total",
+                           "alpa_router_replica_queue_depth",
+                           "alpa_kv_blocks_in_use",
+                           "alpa_kv_prefix_hits_total",
+                           "alpa_kv_bytes_saved_total"):
+                assert family in text, f"missing metric {family}"
+        finally:
+            server.shutdown()
+
+    def test_healthz_503_when_no_replica_routable(self):
+        r = Router()
+        server = RouterServer(r, port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(base + "/healthz")
+            assert exc.value.code == 503
+        finally:
+            server.shutdown()
+
+
+class TestPagedControllerRegistration:
+    """ISSUE 11 satellite: under kv_paged + kv_prefix_reuse, registered
+    prefixes become pre-warmed paged-index entries — replicas of one
+    model may register DIFFERENT prefixes (the old same-prefix error is
+    gone), and requests send full prompts."""
+
+    def test_different_prefixes_coexist(self, monkeypatch):
+        monkeypatch.setattr(global_config, "kv_paged", True)
+        monkeypatch.setattr(global_config, "kv_prefix_reuse", True)
+        monkeypatch.setattr(global_config, "kv_block_size", 8)
+        ctrl = Controller()
+        gen_a, _m, _p, _c = _tiny(prefill_chunk=8)
+        gen_b, _m, _p, _c = _tiny(prefill_chunk=8)
+        pre_a = list(range(1, 9))
+        pre_b = list(range(11, 19))
+        ctrl.register_model("m", gen_a, prefix_ids=pre_a)
+        # old behavior raised on a mismatched second prefix; paged
+        # supersession accepts it
+        ctrl.register_model("m", gen_b, prefix_ids=pre_b)
+        out = ctrl.completions({"model": "m",
+                                "prompt_ids": pre_a + [30, 31],
+                                "max_new_tokens": 3})
+        assert len(out["output_ids"][0]) == len(pre_a) + 2 + 3
+
+    def test_legacy_same_prefix_rule_kept_when_reuse_off(self,
+                                                         monkeypatch):
+        monkeypatch.setattr(global_config, "kv_paged", False)
+        ctrl = Controller()
+        gen_a, _m, _p, _c = _tiny(prefill_chunk=8)
+        gen_b, _m, _p, _c = _tiny(prefill_chunk=8)
+        ctrl.register_model("m", gen_a, prefix_ids=[1, 2, 3])
+        with pytest.raises(ValueError):
+            ctrl.register_model("m", gen_b, prefix_ids=[4, 5, 6])
