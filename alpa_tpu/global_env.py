@@ -184,6 +184,29 @@ class GlobalConfig:
         self.reshard_quantize_min_bytes = int(os.environ.get(
             "ALPA_TPU_RESHARD_QUANTIZE_MIN_BYTES", "65536"))
 
+        # ---------- profile-guided replanning (ISSUE 12) ----------
+        # Close the loop from measured step performance back into the
+        # planners (telemetry/calibration.py): "off" plans from the
+        # analytic cost models exactly as before (byte-identical plans,
+        # unchanged cache keys); "suggest" consults the measured-cost
+        # calibration store and logs the predicted critical-path delta
+        # of a replan without applying it; "auto" re-solves with
+        # measured costs and hot-swaps the new plan through the compile
+        # cache + plan-fingerprint machinery (the static plan verifier
+        # re-runs on the swapped plan).
+        self.replan_mode = os.environ.get("ALPA_TPU_REPLAN_MODE", "off")
+        # Minimum ingested samples before a calibrated entry overrides
+        # its analytic prediction; below this the planners fall back to
+        # the analytic model.
+        self.calibration_min_samples = int(os.environ.get(
+            "ALPA_TPU_CALIBRATION_MIN_SAMPLES", "3"))
+        # On-disk tier of the calibration store (one JSON file per
+        # entry, atomic writes, content-addressed like the compile
+        # cache).  Unset = memory-only: measurements calibrate this
+        # process but do not persist across restarts.
+        self.calibration_dir = os.environ.get(
+            "ALPA_TPU_CALIBRATION_DIR", None)
+
         # ---------- compile cache ----------
         # On-disk tier of the persistent compile cache (ILP auto-sharding
         # solutions, stage-DP decisions, parallel_plan artifacts — see

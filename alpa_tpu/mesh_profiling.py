@@ -38,6 +38,15 @@ DEFAULT_SEC_PER_FLOP = 1.0 / 100e12
 COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
                     "all_to_all")
 
+# Version stamp written into saved profiling DBs (ISSUE 12 satellite):
+# load() validates it and warns on mismatch; stampless files are the
+# pre-stamp legacy layout and load with a warning.
+PROF_DB_SCHEMA_VERSION = 1
+
+# (kind, key) pairs already warned for out-of-range lookups — one
+# warning per distinct query, not one per call.
+_warned_out_of_range: set = set()
+
 
 @dataclasses.dataclass
 class CalibratedCostModel:
@@ -87,13 +96,28 @@ class MeshProfilingResult:
             (float(size), float(seconds)))
 
     def estimate(self, kind: str, key: Tuple, size: float) -> Optional[float]:
-        """Linear interpolation on measured (size, time) points."""
+        """Linear interpolation on measured (size, time) points.
+
+        A lookup outside the profiled size range WARNs (once per (kind,
+        key) — the key carries the mesh/axis shape for collectives)
+        instead of silently clamping to the nearest measured entry, so a
+        query the DB cannot honestly answer is visible (ISSUE 12
+        satellite)."""
         points = getattr(self, f"{kind}_cost_dict").get(tuple(key))
         if not points:
             return None
         points = sorted(points)
         sizes = np.array([p[0] for p in points], dtype=float)
         times = np.array([p[1] for p in points], dtype=float)
+        if size < sizes[0] or size > sizes[-1]:
+            wkey = (kind, tuple(key))
+            if wkey not in _warned_out_of_range:
+                _warned_out_of_range.add(wkey)
+                logger.warning(
+                    "profiling DB lookup out of measured range: kind=%s "
+                    "key=%s size=%.3g not in [%.3g, %.3g] — clamping to "
+                    "the nearest profiled entry", kind, tuple(key), size,
+                    sizes[0], sizes[-1])
         return float(np.interp(size, sizes, times))
 
     def fit(self) -> CalibratedCostModel:
@@ -165,15 +189,36 @@ class ProfilingResultDatabase:
 
     def save(self, filename: str):
         with open(filename, "w", encoding="utf-8") as f:
-            json.dump({k: v.to_json() for k, v in self.data.items()}, f,
+            json.dump({"schema_version": PROF_DB_SCHEMA_VERSION,
+                       "meshes": {k: v.to_json()
+                                  for k, v in self.data.items()}}, f,
                       indent=1)
 
     @classmethod
     def load(cls, filename: str) -> "ProfilingResultDatabase":
+        """Load + validate a profiling DB file (ISSUE 12 satellite):
+        the stamped ``{"schema_version": N, "meshes": {...}}`` layout is
+        checked against :data:`PROF_DB_SCHEMA_VERSION`; bare-dict legacy
+        files (pre-stamp ``prof_database_*.json``) still load, with a
+        warning suggesting a re-save."""
         with open(filename, encoding="utf-8") as f:
             raw = json.load(f)
+        if "schema_version" in raw:
+            version = raw["schema_version"]
+            if version != PROF_DB_SCHEMA_VERSION:
+                logger.warning(
+                    "profiling DB %s has schema_version=%s (this build "
+                    "reads %s); attempting to load anyway", filename,
+                    version, PROF_DB_SCHEMA_VERSION)
+            meshes = raw.get("meshes", {})
+        else:
+            logger.warning(
+                "profiling DB %s has no schema_version stamp (legacy "
+                "layout); re-save it to stamp schema_version=%s",
+                filename, PROF_DB_SCHEMA_VERSION)
+            meshes = raw
         return cls({k: MeshProfilingResult.from_json(v)
-                    for k, v in raw.items()})
+                    for k, v in meshes.items()})
 
 
 # ---- analytic per-generation interconnect defaults ----
@@ -466,8 +511,15 @@ def estimate_stage_cost(stage_comps,
     real seconds; otherwise abstract units with a fixed exchange rate.
     This replaces the reference's compile-and-profile workers as the
     default path (HloCostModelProfileWorker analog).
+
+    Under ``replan_mode != off`` (ISSUE 12), a measured stage cost from
+    the calibration store — keyed by the content fingerprint (flops,
+    submesh size) this function computes — supersedes the whole
+    analytic estimate once it clears ``calibration_min_samples``; the
+    analytic value is recorded on the entry as the drift denominator.
     """
     from alpa_tpu.pipeline_parallel.computation import merge_computations
+    from alpa_tpu.telemetry import calibration as _calibration
 
     comp = (merge_computations(stage_comps, "cost_probe")
             if len(stage_comps) > 1 else stage_comps[0])
@@ -504,7 +556,15 @@ def estimate_stage_cost(stage_comps,
                 comm_cost = units * 1e-7
         except Exception as e:  # pylint: disable=broad-except
             logger.debug("stage ILP cost estimate failed: %s", e)
-    return compute_cost + comm_cost
+    analytic = compute_cost + comm_cost
+    if _calibration.replan_active():
+        store = _calibration.get_calibration_store()
+        sig = _calibration.stage_cost_signature(flops, n_dev)
+        store.set_modeled("stage_run", sig, analytic * 1e6)
+        measured = store.measured_us("stage_run", sig)
+        if measured is not None:
+            return measured * 1e-6
+    return analytic
 
 
 #: optimizer-state bytes per parameter byte (Adam-family: mu + nu)

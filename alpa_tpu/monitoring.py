@@ -189,6 +189,10 @@ def dump_debug_info(executable, dump_dir: str):
     from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
         format_resharding_plan)
     write("resharding_plan.txt", format_resharding_plan())
+    # measured-cost calibration store + model drift (ISSUE 12); also
+    # printable standalone via `scripts/perf_tool.py drift`
+    from alpa_tpu.telemetry.calibration import format_calibration_report
+    write("calibration.txt", format_calibration_report())
     write("compile_cache.txt", format_compile_cache_report())
     write("checkpoint.txt", format_checkpoint_report())
     write("overlap.txt", format_overlap_report())

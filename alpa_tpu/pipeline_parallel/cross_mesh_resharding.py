@@ -742,11 +742,15 @@ def collective_options(shape, itemsize, src_sharding, dst_sharding
 
 def _strategy_cost(stats: Dict[str, float], kind: Optional[str],
                    nbytes: float, cal, lat: float, bw: float,
-                   model: str) -> float:
+                   model: str, intra_us: Optional[float] = None) -> float:
     """Estimated edge seconds = cross-mesh wire leg (mirroring the
     active emulation model, so auto selection is honest about what it is
     timed against) + intra-destination collective leg from
-    mesh_profiling's calibrated (alpha, beta) cost dicts."""
+    mesh_profiling's calibrated (alpha, beta) cost dicts.
+
+    ``intra_us`` (ISSUE 12): a measured collective cost from the
+    calibration store that supersedes the alpha-beta estimate for the
+    intra leg."""
     if model == "link":
         cross = lat * stats["max_link_messages"]
     else:                       # "call": one idle per transfer call
@@ -754,7 +758,9 @@ def _strategy_cost(stats: Dict[str, float], kind: Optional[str],
     if bw:
         cross += stats["max_link_bytes"] / bw
     intra = 0.0
-    if kind is not None and cal is not None:
+    if intra_us is not None:
+        intra = intra_us * 1e-6
+    elif kind is not None and cal is not None:
         ab = cal.alpha_beta(kind)
         if ab is not None:
             intra = ab[0] + ab[1] * nbytes
@@ -767,9 +773,19 @@ def choose_strategy(shape, itemsize, src_sharding, dst_sharding
     """Pick the cheapest eligible strategy for one cross-mesh edge
     (``global_config.reshard_strategy`` forces a specific one when not
     "auto"; ineligible forced strategies fall back to direct_p2p).
-    Returns (strategy, per-candidate costs, candidate options)."""
+    Returns (strategy, per-candidate costs, candidate options).
+
+    Under ``replan_mode != off`` (ISSUE 12) the calibration store
+    supersedes the analytic price wherever it has enough measured
+    samples: per-candidate wire cost by the edge signature (only the
+    strategies that actually ran get measured overrides — the rest stay
+    analytic, so a mispriced edge can flip the choice), and the intra
+    collective leg by mesh_profiling-style (kind, byte-bucket) keys.
+    The analytic prediction each override supersedes is recorded on the
+    entry as the drift denominator."""
     from alpa_tpu.global_env import global_config
     from alpa_tpu.mesh_profiling import get_effective_calibration
+    from alpa_tpu.telemetry import calibration as _calibration
     opts = collective_options(shape, itemsize, src_sharding, dst_sharding)
     try:
         cal = get_effective_calibration()
@@ -780,9 +796,31 @@ def choose_strategy(shape, itemsize, src_sharding, dst_sharding
     model = getattr(global_config, "resharding_wire_model", "call")
     nbytes = float(np.prod(shape, dtype=np.int64)) * itemsize \
         if shape else float(itemsize)
+    store = _calibration.get_calibration_store() \
+        if _calibration.replan_active() else None
+
+    def _intra_us(kind):
+        if store is None or kind is None:
+            return None
+        return store.measured_us(
+            "collective", _calibration.collective_signature(kind, nbytes))
+
     costs = {name: _strategy_cost(o["stats"], o["kind"], nbytes, cal,
-                                  lat, bw, model)
+                                  lat, bw, model,
+                                  intra_us=_intra_us(o["kind"]))
              for name, o in opts.items()}
+    if store is not None:
+        src_key = _sharding_key(src_sharding)
+        dst_key = _sharding_key(dst_sharding)
+        for name in opts:
+            sig = _calibration.wire_signature(shape, itemsize, src_key,
+                                              dst_key, name)
+            # attach the analytic price this entry would supersede
+            # (drift denominator) before consulting it
+            store.set_modeled("reshard_wire", sig, costs[name] * 1e6)
+            measured = store.measured_us("reshard_wire", sig)
+            if measured is not None:
+                costs[name] = measured * 1e-6
     forced = getattr(global_config, "reshard_strategy", "auto")
     if forced != "auto":
         chosen = forced if forced in opts else "direct_p2p"
@@ -797,16 +835,23 @@ def resolve_strategy(shape, itemsize, src_sharding, dst_sharding
     """Cache-backed :func:`choose_strategy`: per-edge decisions persist
     in the compile cache (namespace ``reshard_strategy``), so a warm
     restart replays the identical plan without re-costing.  The key
-    covers the edge signature AND every knob the cost model reads.
+    covers the edge signature AND every knob the cost model reads —
+    plus, when replanning is active, the calibration-store fingerprint
+    (ISSUE 12): a calibrated re-solve caches like any other plan, an
+    unchanged store replays it, and ``replan_mode=off`` keys stay
+    byte-identical to a build without calibration.
     Returns (strategy, costs, from_cache)."""
     from alpa_tpu.compile_cache import cache_enabled, get_compile_cache
     from alpa_tpu.global_env import global_config
+    from alpa_tpu.telemetry.calibration import calibration_cache_token
+    tok = calibration_cache_token()
     parts = (tuple(shape), int(itemsize),
              _sharding_key(src_sharding), _sharding_key(dst_sharding),
              getattr(global_config, "reshard_strategy", "auto"),
              getattr(global_config, "resharding_wire_model", "call"),
              global_config.resharding_transfer_latency_s,
-             getattr(global_config, "resharding_wire_bandwidth", 0.0))
+             getattr(global_config, "resharding_wire_bandwidth", 0.0)) \
+        + ((tok,) if tok else ())
     cache = get_compile_cache() if cache_enabled() else None
     key = cache.make_key("reshard_strategy", parts) if cache else None
     if cache is not None:

@@ -477,6 +477,7 @@ class PipeshardDriverExecutable:
                         from alpa_tpu.pipeline_parallel. \
                             cross_mesh_resharding import (ReshardingTask,
                                                           plan_resharding)
+                        inst.src_sharding = src_sh
                         inst.plan = plan_resharding(
                             tuple(v.aval.shape), v.aval.dtype.itemsize,
                             src_sh, dst_sharding)
@@ -1380,6 +1381,15 @@ class PipeshardDriverExecutable:
                          [e for e in self.apply_execs if e is not None]),
             mode=mode, run_stats=stats)
         _perf.publish_report(report)
+        try:
+            # fold the measured step into the calibration store (ISSUE
+            # 12): per-stage RUN costs and per-edge wire costs become
+            # the drift gauges' samples and, under replan_mode, the
+            # planners' measured overrides
+            from alpa_tpu.telemetry import calibration as _calibration
+            _calibration.ingest_joined(joined)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("calibration ingest failed")
         return report
 
     def get_perf_report_text(self) -> str:
@@ -1394,6 +1404,137 @@ class PipeshardDriverExecutable:
                     "enable tracing via ALPA_TPU_TRACE=1 or the flight "
                     "ring via ALPA_TPU_FLIGHT=1 and run a step)")
         return report.format_text()
+
+    def get_calibration_text(self) -> str:
+        """``calibration.txt`` content for dump_debug_info: the measured
+        -cost store's entries ranked by drift from the analytic model."""
+        from alpa_tpu.telemetry.calibration import format_calibration_report
+        return format_calibration_report()
+
+    def consider_replan(self, report=None):
+        """Profile-guided replanning (ISSUE 12): compare the measured
+        step against the calibration store's view and — per
+        ``global_config.replan_mode`` — recommend or apply a replan.
+
+        * ``off``: returns None; nothing consulted, plans untouched.
+        * ``suggest``: re-prices every cross-mesh edge under the
+          calibrated cost model, logs the predicted critical-path delta
+          from the ISSUE 9 ``simulate_dag`` what-if engine, and returns
+          the verdict without applying anything.
+        * ``auto``: additionally re-plans the flipped edges (through the
+          calibration-fingerprinted compile-cache path, so a warm
+          restart replays the same replan with zero solves) and
+          hot-swaps the lowered programs — the static plan verifier
+          re-runs on the swapped plan in ``_ensure_lowered``.
+
+        Returns a verdict dict (baseline/predicted critical path µs,
+        per-edge strategy flips, plan fingerprints) or None when replan
+        is off / no measured step is available."""
+        from alpa_tpu.analysis.critical_path import simulate_dag
+        from alpa_tpu.pipeline_parallel import (cross_mesh_resharding as
+                                                _cmr)
+        from alpa_tpu.telemetry import calibration as _calibration
+        mode = getattr(global_config, "replan_mode", "off")
+        if mode == "off":
+            return None
+        if report is None:
+            report = self.get_perf_report()  # ingests into the store
+        if report is None or not report.sim_durs_us:
+            return None
+        store = _calibration.get_calibration_store()
+        baseline_us, _ = simulate_dag(report.sim_durs_us,
+                                      report.sim_preds)
+
+        # Re-price every cross-mesh edge under the calibrated chooser.
+        # resolve_strategy's key carries the store fingerprint, so these
+        # decisions cache and replay on warm restart.
+        edge_cost_us: Dict[Tuple[str, str], float] = {}
+        flips = []
+        for inst in self.instructions:
+            if inst.opcode != PipelineInstType.RESHARD or \
+                    inst.plan is None or inst.src_sharding is None:
+                continue
+            try:
+                chosen, costs, _cached = _cmr.resolve_strategy(
+                    inst.plan.shape, inst.plan.itemsize,
+                    inst.src_sharding, inst.dst_sharding)
+            except Exception:  # pylint: disable=broad-except
+                logger.exception("replan: re-pricing %s failed",
+                                 inst.info)
+                continue
+            edge = (str(inst.src_mesh), str(inst.dst_mesh))
+            cost_us = costs.get(chosen, 0.0) * 1e6
+            edge_cost_us[edge] = max(edge_cost_us.get(edge, 0.0),
+                                     cost_us)
+            if chosen != inst.plan.strategy:
+                flips.append((inst, inst.plan.strategy, chosen))
+
+        # Predicted critical path of the (re)planned step: measured
+        # stage medians for RUNs, the calibrated chooser's edge cost
+        # (falling back to the measured wire median) for transfer waits.
+        durs = list(report.sim_durs_us)
+        for op in report.sim_ops:
+            m = None
+            stage = _calibration._stage_from_name(op.name)  # pylint: disable=protected-access
+            if stage is not None:
+                m = store.measured_us("stage_run",
+                                      _calibration.stage_signature(stage))
+            elif op.kind == "wait":
+                edge = _calibration._edge_from_name(op.name)  # pylint: disable=protected-access
+                if edge is not None:
+                    m = edge_cost_us.get(edge)
+                    if m is None:
+                        m = store.measured_us(
+                            "reshard_wire",
+                            _calibration.edge_signature(*edge))
+            if m is not None and 0 <= op.idx < len(durs):
+                durs[op.idx] = m
+        predicted_us, _ = simulate_dag(durs, report.sim_preds)
+        verdict = {
+            "mode": mode,
+            "baseline_critical_path_us": baseline_us,
+            "predicted_critical_path_us": predicted_us,
+            "predicted_ratio": (predicted_us / baseline_us
+                                if baseline_us > 0 else 1.0),
+            "n_edges_repriced": len(edge_cost_us),
+            "strategy_flips": [
+                {"edge": f"{i.src_mesh}->{i.dst_mesh}", "var": i.info,
+                 "from": old, "to": new} for i, old, new in flips],
+            "applied": False,
+            "calibration_fingerprint": store.fingerprint(),
+        }
+        logger.info(
+            "replan(%s): predicted critical path %.1f us vs measured "
+            "%.1f us (ratio %.3f), %d strategy flip(s)", mode,
+            predicted_us, baseline_us, verdict["predicted_ratio"],
+            len(flips))
+        if mode != "auto":
+            return verdict
+
+        # auto: hot-swap — re-plan flipped edges and re-lower so the
+        # verifier re-runs on the swapped plan
+        verdict["plan_fingerprint_before"] = self.get_plan_fingerprint()
+        if flips:
+            from alpa_tpu.pipeline_parallel.cross_mesh_resharding import (
+                ReshardingTask, plan_resharding)
+            for inst, _old, _new in flips:
+                try:
+                    inst.plan = plan_resharding(
+                        inst.plan.shape, inst.plan.itemsize,
+                        inst.src_sharding, inst.dst_sharding)
+                    inst.task = ReshardingTask(inst.plan,
+                                               inst.dst_sharding)
+                except Exception:  # pylint: disable=broad-except
+                    logger.exception("replan: re-planning %s failed; "
+                                     "keeping the old plan", inst.info)
+            modes = list(self._register_programs)
+            self._register_programs.clear()
+            self._register_program = None
+            for m in modes:
+                self._ensure_lowered(m)
+        verdict["applied"] = bool(flips)
+        verdict["plan_fingerprint_after"] = self.get_plan_fingerprint()
+        return verdict
 
     def get_plan_fingerprint(self) -> str:
         """Content hash of the compiled parallel plan: instruction stream

@@ -63,6 +63,10 @@ class PipelineInstruction:
     src_mesh: Optional[int] = None
     dst_mesh: Optional[int] = None
     dst_sharding: Any = None
+    # the source-side sharding the plan was built against — kept so a
+    # profile-guided replan (ISSUE 12) can re-price and re-plan the edge
+    # without re-deriving the emitter's sharding environment
+    src_sharding: Any = None
     # tile-level transfer plan (cross_mesh_resharding.ReshardingTaskSpec)
     plan: Any = None
     # cached executor for planned execution mode

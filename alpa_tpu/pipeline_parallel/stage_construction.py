@@ -19,6 +19,17 @@ from alpa_tpu.telemetry import trace as _ttrace
 logger = logging.getLogger(__name__)
 
 
+def _cal_key_parts() -> List[str]:
+    """Calibration-store fingerprint as extra cache-key parts (ISSUE 12):
+    empty under ``replan_mode=off`` — keys stay byte-identical to a
+    build without calibration — else one ``cal:<fingerprint>`` part, so
+    a measured-cost re-solve caches separately and a warm restart with
+    an unchanged store replays it with zero solves."""
+    from alpa_tpu.telemetry.calibration import calibration_cache_token
+    tok = calibration_cache_token()
+    return [tok] if tok else []
+
+
 @dataclasses.dataclass
 class StageOption:
     """Base (ref stage_construction.py)."""
@@ -213,7 +224,7 @@ def cluster_layers_and_slice_mesh(
                 else "no-as-option",
                 objective,
                 schedule,
-            ] + comp_texts)
+            ] + comp_texts + _cal_key_parts())
             entry = cache.get("stage_dp", key)
             if entry is not None:
                 try:

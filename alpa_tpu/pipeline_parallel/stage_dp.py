@@ -262,6 +262,12 @@ def compute_cost_cache_key(layer_comps, choices, profiling_mode,
     if calibration is not None:
         h.update(repr(sorted(calibration.dot_points)).encode())
         h.update(repr(sorted(calibration.collective_ab.items())).encode())
+    # the cost tensor bakes estimate_stage_cost's calibration-store
+    # consults in (ISSUE 12) — no token under replan_mode=off
+    from alpa_tpu.telemetry.calibration import calibration_cache_token
+    tok = calibration_cache_token()
+    if tok:
+        h.update(tok.encode())
     return h.hexdigest()[:16]
 
 

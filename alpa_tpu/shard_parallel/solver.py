@@ -76,6 +76,8 @@ def plan_auto_sharding(fun: Callable,
     cache = key = None
     if not return_graph and cache_enabled():
         cache = get_compile_cache()
+        from alpa_tpu.telemetry.calibration import calibration_cache_token
+        cal_tok = calibration_cache_token()
         key = cache.make_key("ilp", [
             "plan_auto_sharding",
             str(closed_jaxpr),
@@ -84,7 +86,9 @@ def plan_auto_sharding(fun: Callable,
             repr(tuple(batch_flat_idx)),
             repr((physical_mesh.num_hosts, physical_mesh.num_devices)),
             option,
-        ])
+            # calibration fingerprint (ISSUE 12): absent when
+            # replan_mode=off so off-mode keys stay byte-identical
+        ] + ([cal_tok] if cal_tok else []))
         entry = cache.get("ilp", key)
         if entry is not None:
             with _ttrace.span("ilp-cache-replay", "compile",

@@ -1,0 +1,203 @@
+"""Profile-guided replanning benchmark (ISSUE 12): fixture trace ->
+calibration store -> deliberately mispriced edge -> strategy flip ->
+re-simulated critical path.
+
+Fully deterministic (fixture trace + analytic wire model + injected
+measurements — no wall clocks), so the gate tolerances are tight:
+
+1. Ingest the committed ``perf_gate_fixture_trace.json`` into a fresh
+   calibration store (per-stage RUN medians, per-edge wire medians).
+2. Price a real 2-mesh resharding edge (two 4-device CPU meshes,
+   rowshard -> replicated) under the ``link`` wire model: the analytic
+   winner is ``slice_all_gather``.
+3. Inject the misprice: observed wire samples on the analytic winner
+   at 50x its modeled cost.  The drift gauge
+   (``alpa_cost_model_drift_ratio{kind="reshard_wire"}``) surfaces it.
+4. Replan under ``replan_mode=suggest``: the measured override flips
+   the choice back to ``direct_p2p`` (still analytically priced — only
+   strategies that actually ran get measured overrides).
+5. Re-simulate the fixture step DAG (``simulate_dag``) with the edge
+   priced at the measured cost (original plan) vs the replanned
+   strategy's cost: the post-replan critical-path ratio must be <= 1.
+6. Warm restart: re-ingesting the identical trace leaves the store
+   fingerprint unchanged, and ``resolve_strategy`` replays the flipped
+   decision from the compile cache without re-solving.
+
+Usage:  python benchmark/replan_bench.py [--out F] [--gate]
+
+``--gate`` checks the ``replan.*`` metrics against
+``benchmark/results/perf_gate_baseline.json`` (PR 9 gate) and exits
+nonzero on regression.  Writes benchmark/results/replan.json.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.platform import pin_cpu_platform  # noqa: E402
+
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results", "replan.json")
+FIXTURE_TRACE = os.path.join(REPO, "benchmark", "results",
+                             "perf_gate_fixture_trace.json")
+
+# injected "measured" wire cost on the analytic winner (µs); its
+# modeled price under the knobs below is 10 µs -> drift ratio 50
+MISPRICED_WIRE_US = 500.0
+
+
+def run() -> dict:
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from alpa_tpu.analysis.critical_path import simulate_dag
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel import cross_mesh_resharding as cmr
+    from alpa_tpu.telemetry import calibration as cal
+    from alpa_tpu.telemetry import perf
+
+    prev = (global_config.replan_mode,
+            global_config.calibration_min_samples,
+            global_config.reshard_strategy,
+            global_config.resharding_wire_model,
+            global_config.resharding_transfer_latency_s,
+            global_config.resharding_wire_bandwidth)
+    store = cal.CalibrationStore(None)          # fresh, memory-only
+    cal.reset_calibration_store(store)
+    try:
+        global_config.replan_mode = "suggest"
+        global_config.calibration_min_samples = 3
+        global_config.reshard_strategy = "auto"
+        global_config.resharding_wire_model = "link"
+        global_config.resharding_transfer_latency_s = 1e-5
+        global_config.resharding_wire_bandwidth = 0.0
+
+        # 1. calibrate from the committed fixture trace
+        with open(FIXTURE_TRACE, encoding="utf-8") as f:
+            trace = json.load(f)
+        ingested = cal.ingest_chrome_trace(trace, store=store)
+        report = perf.report_from_trace(trace)
+        assert report is not None, "fixture trace has no analyzable step"
+
+        # 2. analytic price of a real 2-mesh edge
+        devs = jax.devices()
+        src_mesh = Mesh(np.array(devs[:4]), ("x",))
+        dst_mesh = Mesh(np.array(devs[4:8]), ("x",))
+        src = NamedSharding(src_mesh, P("x", None))
+        dst = NamedSharding(dst_mesh, P())
+        shape, itemsize = (8, 8), 4
+        chosen0, costs0, _ = cmr.choose_strategy(shape, itemsize, src, dst)
+
+        # 3. the deliberately mispriced edge: measured wire on the
+        # analytic winner far above its modeled price
+        sig = cal.wire_signature(shape, itemsize, cmr._sharding_key(src),
+                                 cmr._sharding_key(dst), chosen0)
+        for _ in range(global_config.calibration_min_samples + 1):
+            store.observe("reshard_wire", sig, MISPRICED_WIRE_US,
+                          modeled_us=costs0[chosen0] * 1e6,
+                          meta={"source": "replan_bench"})
+        drift_worst = max(
+            (e.drift_ratio for e in store.entries()
+             if e.drift_ratio is not None), default=0.0)
+
+        # 4. replan: the measured override flips the strategy
+        chosen1, costs1, _ = cmr.choose_strategy(shape, itemsize, src, dst)
+        flipped = chosen1 != chosen0
+
+        # 5. re-simulate the fixture DAG: original plan priced at the
+        # measured (mispriced) edge cost vs the replanned strategy
+        wait_idx = [i for i, op in enumerate(report.sim_ops)
+                    if op.kind == "wait"]
+        durs_orig = list(report.sim_durs_us)
+        durs_replan = list(report.sim_durs_us)
+        for i in wait_idx:
+            durs_orig[i] = MISPRICED_WIRE_US
+            durs_replan[i] = costs1[chosen1] * 1e6
+        baseline_us, _ = simulate_dag(durs_orig, report.sim_preds)
+        replanned_us, _ = simulate_dag(durs_replan, report.sim_preds)
+        ratio = replanned_us / baseline_us if baseline_us else 1.0
+
+        # 6. warm restart: identical re-ingest keeps the fingerprint,
+        # and the flipped decision replays from the compile cache
+        fp0 = store.fingerprint()
+        cal.ingest_chrome_trace(trace, store=store)
+        fp_stable = store.fingerprint() == fp0
+        warm0 = cmr.resolve_strategy(shape, itemsize, src, dst)
+        warm1 = cmr.resolve_strategy(shape, itemsize, src, dst)
+        warm_cached = bool(warm1[2]) and warm1[0] == chosen1 \
+            and warm0[0] == chosen1
+
+        # drift gauge actually exported on /metrics text
+        from alpa_tpu.telemetry.metrics import get_registry
+        gauge_exported = ("alpa_cost_model_drift_ratio" in
+                          get_registry().to_prometheus_text())
+
+        gate_metrics = {
+            "replan.critical_path_ratio": round(ratio, 4),
+            "replan.strategy_flipped": float(flipped),
+            "replan.fingerprint_stable": float(fp_stable),
+            "replan.warm_resolve_cached": float(warm_cached),
+            "replan.drift_ratio_worst": round(drift_worst, 4),
+            "replan.drift_gauge_exported": float(gauge_exported),
+        }
+        return {
+            "ingested_signatures": ingested,
+            "edge": {
+                "shape": list(shape), "itemsize": itemsize,
+                "analytic_choice": chosen0,
+                "analytic_costs_us": {n: round(c * 1e6, 3)
+                                      for n, c in costs0.items()},
+                "mispriced_signature": sig,
+                "mispriced_measured_us": MISPRICED_WIRE_US,
+                "replanned_choice": chosen1,
+                "replanned_costs_us": {n: round(c * 1e6, 3)
+                                       for n, c in costs1.items()},
+            },
+            "critical_path": {
+                "original_plan_us": round(baseline_us, 3),
+                "replanned_plan_us": round(replanned_us, 3),
+                "ratio": round(ratio, 4),
+            },
+            "calibration_fingerprint": fp0,
+            "gate_metrics": gate_metrics,
+        }
+    finally:
+        cal.reset_calibration_store(None)
+        (global_config.replan_mode,
+         global_config.calibration_min_samples,
+         global_config.reshard_strategy,
+         global_config.resharding_wire_model,
+         global_config.resharding_transfer_latency_s,
+         global_config.resharding_wire_bandwidth) = prev
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--gate", action="store_true",
+                        help="check replan.* metrics against the "
+                             "committed perf-gate baseline")
+    args = parser.parse_args()
+
+    pin_cpu_platform(8)
+    result = run()
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, indent=2, sort_keys=True))
+    print(f"\nwrote {args.out}")
+
+    if args.gate:
+        from benchmark.perf_gate import gate
+        verdict = gate(result["gate_metrics"])
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit("REPLAN BENCH PERF GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()

@@ -8,6 +8,7 @@ Usage::
     python scripts/perf_tool.py whatif       TRACE.json [--zero reshard]
                                              [--name SUBSTR]
     python scripts/perf_tool.py compare      A.json B.json
+    python scripts/perf_tool.py drift        [TRACE.json] [--top N] [--json]
 
 ``analyze`` prints the full :class:`StepPerfReport` (critical path,
 per-mesh bubble fractions, transfer overlap, stage MFU where RUN spans
@@ -16,7 +17,10 @@ trace; ``critical-path`` prints just the path table; ``whatif``
 re-simulates the step with an op class made free ("if this RESHARD were
 free, step −X%"); ``compare`` diffs two analyzed traces metric by
 metric (the interactive sibling of ``benchmark/perf_gate.py``, which
-does the same against committed baselines with tolerances).
+does the same against committed baselines with tolerances); ``drift``
+prints the measured-cost calibration store's worst modeled-vs-measured
+divergences (ISSUE 12) — pass a trace to ingest it first, or point
+``ALPA_TPU_CALIBRATION_DIR`` at a persisted store.
 
 Traces come from ``scripts/trace_tool.py record``, from
 ``ALPA_TPU_TRACE_DIR`` auto-saves, or from ``dump_debug_info``'s
@@ -157,6 +161,22 @@ def cmd_compare(args):
         print(f"only in {args.b}: {', '.join(only_b)}")
 
 
+def cmd_drift(args):
+    from alpa_tpu.telemetry import calibration as _cal
+    store = _cal.get_calibration_store()
+    if args.trace:
+        ingested = _cal.ingest_chrome_trace(_load(args.trace),
+                                            store=store)
+        print(f"ingested {sum(ingested.values())} samples over "
+              f"{len(ingested)} signatures from {args.trace}",
+              file=sys.stderr)
+    if args.json:
+        print(json.dumps(_cal.drift_table(store, top=args.top),
+                         indent=1))
+    else:
+        print(_cal.format_calibration_report(store))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -188,6 +208,17 @@ def main(argv=None):
     pp.add_argument("a")
     pp.add_argument("b")
     pp.set_defaults(func=cmd_compare)
+
+    pd = sub.add_parser(
+        "drift", help="worst modeled-vs-measured cost divergences from "
+        "the calibration store (ISSUE 12)")
+    pd.add_argument("trace", nargs="?", default=None,
+                    help="optional chrome trace to ingest first")
+    pd.add_argument("--top", type=int, default=0,
+                    help="show only the N worst entries (0 = all)")
+    pd.add_argument("--json", action="store_true",
+                    help="machine-readable drift table")
+    pd.set_defaults(func=cmd_drift)
 
     args = p.parse_args(argv)
     args.func(args)

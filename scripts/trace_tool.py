@@ -5,6 +5,7 @@ Usage::
     python scripts/trace_tool.py record  OUT.json -- CMD [ARGS...]
     python scripts/trace_tool.py merge   OUT.json TRACE.json [TRACE.json...]
     python scripts/trace_tool.py summarize TRACE.json [--top N] [--bubbles]
+                                           [--edges]
     python scripts/trace_tool.py top     TRACE.json [--top N]
     python scripts/trace_tool.py flight  FLIGHT.json [--last N]
 
@@ -16,7 +17,10 @@ track group in Perfetto); ``summarize`` prints total time per category,
 per-track busy/idle/span-count columns, and the longest individual
 spans — ``--bubbles`` additionally runs the step perf analyzer
 (``alpa_tpu.telemetry.perf`` / ``scripts/perf_tool.py``, ISSUE 9) for
-per-mesh bubble fractions; ``top`` aggregates spans by name (hottest
+per-mesh bubble fractions — and ``--edges`` a per-reshard-edge wire
+table (strategy, bytes, wire us, achieved GB/s from ``reshard.wire``
+spans: the human-readable view of exactly what the calibration store
+ingests, ISSUE 12); ``top`` aggregates spans by name (hottest
 instructions first).  All outputs load directly in
 https://ui.perfetto.dev.
 
@@ -144,6 +148,17 @@ def cmd_summarize(args):
                   "instruction/transfer spans)")
         else:
             print(f"\n{report.format_text(top=args.top)}")
+    if args.edges:
+        from alpa_tpu.telemetry import perf as _perf
+        from alpa_tpu.telemetry import calibration as _cal
+        joined = _perf._join_spans(tracked, None)
+        if joined is None:
+            print("\n--edges: no analyzable step (no mesh-track "
+                  "instruction/transfer spans)")
+        else:
+            print("\nreshard edges (wire legs, what the calibration "
+                  "store ingests):")
+            print(_cal.format_edge_table(_cal.edge_wire_table(joined)))
 
 
 def cmd_top(args):
@@ -228,6 +243,9 @@ def main(argv=None):
     ps.add_argument("--bubbles", action="store_true",
                     help="run the step perf analyzer (per-mesh bubble "
                          "fractions, critical path)")
+    ps.add_argument("--edges", action="store_true",
+                    help="per-reshard-edge wire table (strategy, bytes, "
+                         "wire us, achieved GB/s) from reshard.wire spans")
     ps.set_defaults(func=cmd_summarize)
 
     pt = sub.add_parser("top", help="hottest span names")
