@@ -17,10 +17,20 @@ Two halves:
 * :mod:`alpa_tpu.analysis.critical_path` — pure-data critical-path
   walk + dependency-DAG re-simulation (ISSUE 9) under
   :mod:`alpa_tpu.telemetry.perf`.
+* :mod:`alpa_tpu.analysis.model_check` — an explicit-state model
+  checker (ISSUE 13) exploring all stream interleavings of a plan
+  under explicit SEND/RECV channel semantics (rendezvous and
+  buffered), with partial-order reduction, hazard re-checking in
+  every interleaving, overlap-window verification, and fault/retry
+  safety classification.  Runs as the fifth ``verify_program``
+  analysis behind ``global_config.verify_plans_model_check``.
 """
 from alpa_tpu.analysis.critical_path import (  # noqa: F401
     CriticalPathReport, PathStep, TimedOp, longest_path,
     measured_critical_path, simulate_dag)
+from alpa_tpu.analysis.model_check import (  # noqa: F401
+    ModelCheckResult, check_model, load_fixture, model_from_dict,
+    model_to_dict)
 from alpa_tpu.analysis.plan_verifier import (  # noqa: F401
     Finding, PlanModel, PlanVerdict, PlanVerificationError,
     verify_model)

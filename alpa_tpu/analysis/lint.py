@@ -27,6 +27,12 @@ Checked invariants (one code per rule):
     Trace-counter calls (``ttrace.counter(name, value)``) have no
     literal description and are not metric families.
 
+``metric-doc``
+    Every metric family registered through the telemetry registry must
+    appear in ``docs/observability.md`` (mirrors ``config-doc`` for
+    knobs).  An undocumented family is invisible to operators reading
+    the metric reference — it may as well not exist.
+
 ``timer-import``
     No new imports of the deprecated ``alpa_tpu.timer`` bridge outside
     the two grandfathered call sites (the package re-export and the
@@ -180,17 +186,16 @@ def _check_global_config(root: str) -> List[Violation]:
 # ---- rule: metric-name ------------------------------------------------
 
 
-def _check_metric_names(root: str, rel: str,
-                        tree: ast.AST) -> List[Violation]:
-    out: List[Violation] = []
+def _metric_families(tree: ast.AST) -> Iterable[Tuple[str, int]]:
+    """Yield ``(name, lineno)`` for every metric *family* registration
+    in the tree.  A family registration carries (name, description):
+    two leading string literals.  Trace counters (name, value) and
+    dynamic names (f-strings) are out of scope."""
     for node in ast.walk(tree):
         if not (isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
                 and node.func.attr in ("counter", "gauge", "histogram")):
             continue
-        # A metric *family* registration carries (name, description):
-        # two leading string literals.  Trace counters (name, value) and
-        # dynamic names (f-strings) are out of scope.
         if len(node.args) < 2:
             continue
         name_arg, desc_arg = node.args[0], node.args[1]
@@ -199,12 +204,45 @@ def _check_metric_names(root: str, rel: str,
                 and isinstance(desc_arg, ast.Constant)
                 and isinstance(desc_arg.value, str)):
             continue
-        if not _METRIC_NAME_RE.match(name_arg.value):
+        yield name_arg.value, node.lineno
+
+
+def _check_metric_names(root: str, rel: str,
+                        tree: ast.AST) -> List[Violation]:
+    out: List[Violation] = []
+    for name, lineno in _metric_families(tree):
+        if not _METRIC_NAME_RE.match(name):
             out.append(Violation(
-                "metric-name", rel, node.lineno,
-                f"metric family {name_arg.value!r} does not match "
+                "metric-name", rel, lineno,
+                f"metric family {name!r} does not match "
                 f"alpa_[a-z0-9_]* (keep the /metrics namespace "
                 f"coherent)"))
+    return out
+
+
+# ---- rule: metric-doc -------------------------------------------------
+
+
+def _observability_text(root: str) -> str:
+    path = os.path.join(root, "docs", "observability.md")
+    if not os.path.isfile(path):
+        return ""
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _check_metric_docs(rel: str, tree: ast.AST,
+                       obs_text: str) -> List[Violation]:
+    out: List[Violation] = []
+    for name, lineno in _metric_families(tree):
+        # Malformed names are already flagged by metric-name; only
+        # well-formed families get the documentation requirement.
+        if _METRIC_NAME_RE.match(name) and name not in obs_text:
+            out.append(Violation(
+                "metric-doc", rel, lineno,
+                f"metric family {name!r} is not documented in "
+                f"docs/observability.md (add a row to the metric "
+                f"reference)"))
     return out
 
 
@@ -283,6 +321,7 @@ def run_lint(root: Optional[str] = None) -> List[Violation]:
     (empty list = clean), ordered by path then line."""
     root = root or repo_root()
     known = _known_sites()
+    obs_text = _observability_text(root)
     out: List[Violation] = list(_check_global_config(root))
     for path in _iter_py_files(root):
         tree = _parse(path)
@@ -291,6 +330,7 @@ def run_lint(root: Optional[str] = None) -> List[Violation]:
             out.append(Violation("parse", rel, 1, "file failed to parse"))
             continue
         out.extend(_check_metric_names(root, rel, tree))
+        out.extend(_check_metric_docs(rel, tree, obs_text))
         out.extend(_check_timer_imports(root, rel, tree))
         out.extend(_check_fault_sites(root, rel, tree, known))
     out.sort(key=lambda v: (v.path, v.line, v.code))

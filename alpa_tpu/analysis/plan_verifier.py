@@ -3,8 +3,8 @@ register-file programs (ISSUE 8 tentpole).
 
 The pipeshard compiler's output is a *static* instruction program
 (RUN/RESHARD/FREE per mesh), which makes it exactly the artifact that
-can be verified before it ever touches hardware.  This module runs four
-analyses over the lowering's dataflow graph on EVERY
+can be verified before it ever touches hardware.  This module runs
+five analyses over the lowering's dataflow graph on EVERY
 ``lower_to_register_file`` compile (gated by
 ``global_config.verify_plans`` = ``"error" | "warn" | "off"``,
 default ``"warn"``):
@@ -35,6 +35,13 @@ default ``"warn"``):
    and batched transfer groups contain only groupable (``direct_p2p``)
    members — collective-strategy and quantized RESHARDs must never be
    folded into a multi-member group.
+5. **Model checking** (ISSUE 13, :mod:`alpa_tpu.analysis.model_check`,
+   gated by ``global_config.verify_plans_model_check``) — an
+   explicit-state exploration of every stream interleaving under real
+   SEND/RECV FIFO channel semantics (rendezvous and buffered), with
+   hazard re-checking per schedule, in-flight-window verification, and
+   a static fault/retry-safety classification installed into
+   ``fault.call_with_retry``.
 
 The result is a :class:`PlanVerdict` (errors / warnings / stats),
 cached in the compile cache (namespace ``plan_verdict``, keyed by the
@@ -60,14 +67,16 @@ __all__ = [
     "verify_model", "verify_program", "verify_edge",
 ]
 
-#: the four analyses, in report order
-ANALYSES = ("typing", "deadlock", "liveness", "structure")
+#: the five analyses, in report order
+ANALYSES = ("typing", "deadlock", "liveness", "structure",
+            "model_check")
 
 #: bump when an analysis changes meaning — invalidates cached verdicts
 #: (v2: launch-placed slots are accounted at per-device bytes derived
 #: from their static sharding, so ZeRO-sharded optimizer state shows
-#: the ~dp× reduction in ``peak_bytes``)
-ANALYSES_VERSION = 2
+#: the ~dp× reduction in ``peak_bytes``; v3: the ISSUE-13 model checker
+#: joins as the fifth analysis and verdicts grow a ``notes`` severity)
+ANALYSES_VERSION = 3
 
 _REG = _tmetrics.get_registry()
 _PEAK_BYTES = _REG.gauge(
@@ -170,28 +179,37 @@ class PlanModel:
     deps: Dict[int, Set[int]]               # op -> cross-stream waits
     mode: str = "registers"
     device_memory_bytes: Optional[float] = None
+    # (src_mesh, dst_mesh) -> cross-mesh RESHARD op indices in emission
+    # (== send) order; the model checker's channel FIFO programs.
+    channels: Dict[Tuple[int, int], List[int]] = \
+        dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
 class PlanVerdict:
-    """Errors / warnings / stats from one verification run.  Picklable
-    and JSON-able: cached in the compile cache and replayed verbatim on
-    warm restarts."""
+    """Errors / warnings / notes / stats from one verification run.
+    Picklable and JSON-able: cached in the compile cache and replayed
+    verbatim on warm restarts.  ``notes`` (ISSUE 13) carry descriptive
+    findings — retry-safety classifications, partial model-check
+    coverage — that neither fail the plan nor count as warnings."""
     errors: List[Finding] = dataclasses.field(default_factory=list)
     warnings: List[Finding] = dataclasses.field(default_factory=list)
     stats: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    notes: List[Finding] = dataclasses.field(default_factory=list)
 
     @property
     def ok(self) -> bool:
         return not self.errors
 
     def findings(self) -> List[Finding]:
-        return list(self.errors) + list(self.warnings)
+        return list(self.errors) + list(self.warnings) + \
+            list(self.notes)
 
     def to_dict(self) -> Dict[str, Any]:
         return {"version": ANALYSES_VERSION,
                 "errors": [f.to_dict() for f in self.errors],
                 "warnings": [f.to_dict() for f in self.warnings],
+                "notes": [f.to_dict() for f in self.notes],
                 "stats": dict(self.stats)}
 
     @classmethod
@@ -200,6 +218,7 @@ class PlanVerdict:
             errors=[Finding.from_dict(x) for x in d.get("errors", ())],
             warnings=[Finding.from_dict(x)
                       for x in d.get("warnings", ())],
+            notes=[Finding.from_dict(x) for x in d.get("notes", ())],
             stats=dict(d.get("stats", {})))
 
     def format_table(self) -> str:
@@ -250,8 +269,17 @@ class PlanVerdict:
                     f"leaked slots ({len(leaked)}): "
                     + ", ".join(str(v) for v in leaked[:8])
                     + (" ..." if len(leaked) > 8 else ""))
+        mc = st.get("model_check") if st else None
+        if mc:
+            sem = mc.get("semantics", {})
+            lines.append(
+                "model check: "
+                + "  ".join(f"{k}={v}" for k, v in sorted(sem.items()))
+                + f"  states={mc.get('states', 0)}"
+                  f"  reduction_ratio={mc.get('reduction_ratio', 0.0)}")
         for title, items in (("errors", self.errors),
-                             ("warnings", self.warnings)):
+                             ("warnings", self.warnings),
+                             ("notes", self.notes)):
             if items:
                 lines.append(f"{title}:")
                 for f in items:
@@ -372,7 +400,9 @@ def build_model(instructions: Sequence[Any],
                      streams=st.streams,
                      deps={k: set(v) for k, v in st.deps.items()},
                      mode=mode,
-                     device_memory_bytes=_device_memory_bytes())
+                     device_memory_bytes=_device_memory_bytes(),
+                     channels={k: list(v)
+                               for k, v in st.channels.items()})
 
 
 def _device_memory_bytes() -> Optional[float]:
@@ -815,10 +845,17 @@ def check_structure(model: PlanModel,
 
 
 def verify_model(model: PlanModel,
-                 hooks: Optional[Sequence[Any]] = None) -> PlanVerdict:
-    """Run all four analyses over a plan model; pure function of its
+                 hooks: Optional[Sequence[Any]] = None,
+                 model_check: bool = False,
+                 overlap_window: int = 0,
+                 model_check_budget: Optional[int] = None
+                 ) -> PlanVerdict:
+    """Run the analyses over a plan model; pure function of its
     inputs (no metrics, no cache — see :func:`verify_program` for the
-    compile-time wrapper)."""
+    compile-time wrapper).  The fifth analysis (the ISSUE-13 explicit
+    state model checker) is opt-in via ``model_check=True`` — it
+    explores every stream interleaving, so the caller decides whether
+    this plan is worth the state-space walk."""
     t0 = time.perf_counter()
     findings: List[Finding] = []
     findings += check_typing(model)
@@ -827,13 +864,27 @@ def verify_model(model: PlanModel,
     findings += live_findings
     findings += check_structure(model, hooks)
 
+    mc_stats = None
+    mc_severity: Dict[str, str] = {}
+    if model_check:
+        from alpa_tpu.analysis import model_check as _mc
+        mc = _mc.check_model(
+            model, hooks=hooks, overlap_window=overlap_window,
+            budget=model_check_budget or _mc.DEFAULT_STATE_BUDGET)
+        findings += mc.findings
+        mc_severity = {f.code: _mc.severity_of(f.code)
+                       for f in mc.findings}
+        mc_stats = mc.stats
+
     warning_codes = ("liveness.leak", "liveness.dead-store",
                      "liveness.peak-exceeds-memory",
                      "deadlock.channel-reorder")
     verdict = PlanVerdict()
     for f in findings:
-        (verdict.warnings if f.code in warning_codes
-         else verdict.errors).append(f)
+        sev = mc_severity.get(f.code) or (
+            "warning" if f.code in warning_codes else "error")
+        {"error": verdict.errors, "warning": verdict.warnings,
+         "note": verdict.notes}[sev].append(f)
     by_opcode: Dict[str, int] = {}
     for op in model.ops:
         by_opcode[op.kind] = by_opcode.get(op.kind, 0) + 1
@@ -849,13 +900,31 @@ def verify_model(model: PlanModel,
         "verify_seconds": round(time.perf_counter() - t0, 6),
         **live_stats,
     }
+    if mc_stats is not None:
+        verdict.stats["model_check"] = mc_stats
     return verdict
 
 
-def _cache_key(cache, fingerprint: str, mode: str) -> str:
+def _cache_key(cache, fingerprint: str, mode: str,
+               model_checked: bool = False) -> str:
     return cache.make_key(
         "plan_verdict", [f"analyses_v{ANALYSES_VERSION}", mode,
-                         fingerprint])
+                         f"mc{int(model_checked)}", fingerprint])
+
+
+def _model_check_enabled(n_ops: int) -> bool:
+    """Whether the knob asks for the fifth analysis on a plan of
+    ``n_ops`` instructions: ``"all"`` always, ``"fixture"`` (default)
+    only for plans small enough to finish in well under a second,
+    ``"off"`` never."""
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.analysis import model_check as _mc
+    mode = getattr(global_config, "verify_plans_model_check", "fixture")
+    if mode == "all":
+        return True
+    if mode == "fixture":
+        return n_ops <= _mc.FIXTURE_MAX_OPS
+    return False
 
 
 def verify_program(instructions: Sequence[Any],
@@ -876,12 +945,14 @@ def verify_program(instructions: Sequence[Any],
     ``"error"``, log under ``"warn"``).
     """
     from alpa_tpu import compile_cache as _cc
+    from alpa_tpu.global_env import global_config
 
     fingerprint = prog.fingerprint()
+    do_mc = _model_check_enabled(len(instructions))
     cache = _cc.get_compile_cache() if _cc.cache_enabled() else None
     verdict = None
     if cache is not None:
-        key = _cache_key(cache, fingerprint, prog.mode)
+        key = _cache_key(cache, fingerprint, prog.mode, do_mc)
         hit = cache.get("plan_verdict", key)
         if isinstance(hit, dict) and \
                 hit.get("version") == ANALYSES_VERSION:
@@ -892,7 +963,11 @@ def verify_program(instructions: Sequence[Any],
                             protected_keys=protected_keys,
                             mode=prog.mode,
                             opt_state_keys=opt_state_keys)
-        verdict = verify_model(model, hooks=prog.hooks)
+        verdict = verify_model(
+            model, hooks=prog.hooks, model_check=do_mc,
+            overlap_window=getattr(prog, "overlap_window", 0) or 0,
+            model_check_budget=getattr(
+                global_config, "model_check_state_budget", None))
         if cache is not None:
             cache.put("plan_verdict", key, verdict.to_dict())
 
@@ -911,6 +986,26 @@ def verify_program(instructions: Sequence[Any],
     _VERDICTS.labels(
         "error" if verdict.errors
         else ("warning" if verdict.warnings else "ok")).inc()
+
+    # model-check observability + the fault layer's static retry
+    # classification (replayed on cache hits too, so a warm restart's
+    # call_with_retry sees the same refusals as the cold compile)
+    from alpa_tpu.analysis import model_check as _mc
+    from alpa_tpu import fault as _fault
+    mc_stats = verdict.stats.get("model_check")
+    if mc_stats:
+        mc_codes = {f.code for f in verdict.findings()
+                    if f.analysis == "model_check"}
+        result = ("error" if any(_mc.severity_of(c) == "error"
+                                 for c in mc_codes)
+                  else "warning" if any(_mc.severity_of(c) == "warning"
+                                        for c in mc_codes)
+                  else "ok")
+        _mc.export_metrics(mc_stats, result)
+        _fault.install_retry_classification(
+            mc_stats.get("retry_sites", {}))
+    else:
+        _mc.export_metrics({}, "skipped")
 
     _apply_policy(verdict, fingerprint)
     return verdict

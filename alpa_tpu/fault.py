@@ -70,6 +70,7 @@ __all__ = [
     "KNOWN_SITES",
     "RetryPolicy", "RetryExhaustedError", "call_with_retry",
     "set_retry_policy", "get_retry_policy", "retry_stats",
+    "install_retry_classification", "get_retry_classification",
     "MeshHealth", "RecoveryManager", "ServiceDegradedError",
     "make_snapshotter",
 ]
@@ -338,6 +339,48 @@ _POLICY_LOCK = threading.Lock()
 #: Process-global retry accounting: site -> total extra attempts.
 retry_stats: Dict[str, int] = {}
 
+#: Static retry-safety classification per site, installed by the plan
+#: model checker (ISSUE 13, alpa_tpu.analysis.model_check) for the most
+#: recently verified plan: site -> {"classification":
+#: "safe" | "unsafe" | "unreachable", "reasons": [...]}.  Consulted by
+#: call_with_retry under ``global_config.verify_plans == "error"``.
+_RETRY_CLASSIFICATION: Dict[str, Dict[str, Any]] = {}
+
+
+def install_retry_classification(
+        sites: Optional[Dict[str, Dict[str, Any]]]) -> None:
+    """Install (or with ``None``/``{}``, clear) the model checker's
+    per-site retry-safety classification.  Called by
+    ``plan_verifier.verify_program`` on every verified compile — cache
+    hits included, so warm restarts replay identical refusals."""
+    with _POLICY_LOCK:
+        _RETRY_CLASSIFICATION.clear()
+        if sites:
+            _RETRY_CLASSIFICATION.update(
+                {s: dict(e) for s, e in sites.items()})
+
+
+def get_retry_classification() -> Dict[str, Dict[str, Any]]:
+    """The currently installed static retry classification (a copy)."""
+    with _POLICY_LOCK:
+        return {s: dict(e) for s, e in _RETRY_CLASSIFICATION.items()}
+
+
+def _refuse_statically_unsafe(site: str) -> bool:
+    """True when the model checker proved retrying ``site`` unsafe for
+    the verified plan AND the operator runs with verify_plans=error —
+    the strict mode where static proofs override caller-declared
+    idempotency."""
+    with _POLICY_LOCK:
+        ent = _RETRY_CLASSIFICATION.get(site)
+    if not ent or ent.get("classification") != "unsafe":
+        return False
+    try:
+        from alpa_tpu.global_env import global_config
+        return getattr(global_config, "verify_plans", "warn") == "error"
+    except Exception:  # pylint: disable=broad-except
+        return False
+
 
 def set_retry_policy(policy: Optional[RetryPolicy],
                      site: Optional[str] = None):
@@ -396,6 +439,20 @@ def call_with_retry(fn: Callable[[], Any],
             break
         except retry_on as e:  # pylint: disable=broad-except
             retryable = idempotent or isinstance(e, InjectedFault)
+            if retryable and not isinstance(e, InjectedFault) and \
+                    _refuse_statically_unsafe(site):
+                # the model checker proved a real mid-op failure at
+                # this site cannot be retried without double-applying
+                # state (donation / partial group / FIFO reorder);
+                # under verify_plans=error that proof wins over the
+                # caller's idempotent flag
+                logger.warning(
+                    "%s: retry refused — statically classified unsafe "
+                    "by the plan model checker (%s) under "
+                    "verify_plans=error", site,
+                    ",".join(get_retry_classification()
+                             .get(site, {}).get("reasons", ())))
+                retryable = False
             out_of_attempts = attempts >= pol.max_attempts
             out_of_budget = (
                 pol.deadline is not None and

@@ -119,6 +119,20 @@ class GlobalConfig:
         # on the hot path.
         self.verify_plans = os.environ.get(
             "ALPA_TPU_VERIFY_PLANS", "warn")
+        # Fifth analysis (ISSUE 13): explicit-state model checking of
+        # every lowered plan's stream interleavings under real
+        # SEND/RECV FIFO channel semantics.  "all" model-checks every
+        # plan; "fixture" (default) only plans small enough to finish
+        # in well under a second (<= model_check.FIXTURE_MAX_OPS ops);
+        # "off" skips the analysis.  Findings merge into the same
+        # PlanVerdict and obey the verify_plans policy above.
+        self.verify_plans_model_check = os.environ.get(
+            "ALPA_TPU_VERIFY_MODEL_CHECK", "fixture")
+        # DFS state budget for the model checker.  Exhaustion degrades
+        # to partial coverage (reported as a model.budget-exhausted
+        # note + the `partial` stat), never an error.
+        self.model_check_state_budget = int(os.environ.get(
+            "ALPA_TPU_MODEL_CHECK_BUDGET", "50000"))
         # Whether pipeshard runtime overlaps resharding with compute by
         # issuing transfers as soon as producers finish.  This is the
         # gate for the "overlap" dispatch mode under

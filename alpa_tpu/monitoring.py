@@ -181,6 +181,10 @@ def dump_debug_info(executable, dump_dir: str):
     # liveness / structure findings plus peak-live-bytes stats
     if hasattr(executable, "get_plan_verdict_text"):
         write("plan_verdict.txt", executable.get_plan_verdict_text())
+    # explicit-state model checker (ISSUE 13): interleaving coverage,
+    # channel-semantics verdicts, retry-site classification
+    if hasattr(executable, "get_model_check_text"):
+        write("model_check.txt", executable.get_model_check_text())
     # post-step perf analysis (ISSUE 9): critical path, bubbles, MFU
     if hasattr(executable, "get_perf_report_text"):
         write("perf_report.txt", executable.get_perf_report_text())

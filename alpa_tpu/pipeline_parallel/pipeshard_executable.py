@@ -1357,6 +1357,27 @@ class PipeshardDriverExecutable:
                     "lowering failed, or launch not register-eligible)")
         return verdict.format_table()
 
+    def get_model_check_text(self) -> str:
+        """``model_check.txt`` content for dump_debug_info (ISSUE 13):
+        the model checker's stats + findings for the lowered plan."""
+        verdict = None
+        try:
+            verdict = self.get_plan_verdict()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("get_model_check_text failed")
+        if verdict is None:
+            return ("model check: (not available — verify_plans=off, "
+                    "lowering failed, or launch not register-eligible)")
+        mc_stats = verdict.stats.get("model_check")
+        if not mc_stats:
+            return ("model check: (not run — "
+                    "verify_plans_model_check=off or plan exceeds "
+                    "fixture-mode size gate)")
+        from alpa_tpu.analysis import model_check as _mc
+        mc_findings = [f for f in verdict.findings()
+                       if f.analysis == "model_check"]
+        return _mc.format_stats(mc_stats, mc_findings)
+
     def get_perf_report(self):
         """Post-step :class:`~alpa_tpu.telemetry.perf.StepPerfReport`
         (ISSUE 9): critical path, per-mesh bubbles, transfer overlap,

@@ -128,6 +128,32 @@ class InstructionStreams:
     streams: List[List[int]]
     deps: Dict[int, set]
     stream_of: Dict[int, int]
+    # per-(src, dst)-mesh FIFO channel metadata: edge -> the cross-mesh
+    # RESHARD indices that travel it, in emission (= send) order.  The
+    # ISSUE-13 model checker binds its SEND/RECV micro-ops to these
+    # channels (carried on PlanModel.channels).
+    channels: Dict[Tuple[int, int], List[int]] = dataclasses.field(
+        default_factory=dict)
+
+
+def instructions_independent(a, b) -> bool:
+    """True when two instructions commute: no value key is touched by
+    both with at least one side writing/killing it.  The model checker
+    (and any reordering optimization) may swap independent ops without
+    changing program meaning."""
+    acc_a = instruction_accesses(a)
+    acc_b = instruction_accesses(b)
+    keys_b: Dict[Tuple[int, int, int], str] = {}
+    for key, kind in acc_b:
+        if keys_b.get(key) != "write" and keys_b.get(key) != "kill":
+            keys_b[key] = kind
+    for key, kind in acc_a:
+        other = keys_b.get(key)
+        if other is None:
+            continue
+        if kind != "read" or other != "read":
+            return False
+    return True
 
 
 def instruction_accesses(inst) -> List[Tuple[Tuple[int, int, int], str]]:
@@ -169,6 +195,7 @@ def partition_streams(instructions: List[PipelineInstruction],
     streams: List[List[int]] = [[] for _ in range(num_meshes)]
     stream_of: Dict[int, int] = {}
     deps: Dict[int, set] = {}
+    channels: Dict[Tuple[int, int], List[int]] = {}
     # key -> ordered access history: (global_idx, stream, kind)
     history: Dict[Tuple[int, int, int], List[Tuple[int, int, str]]] = {}
 
@@ -178,6 +205,9 @@ def partition_streams(instructions: List[PipelineInstruction],
             m = inst.dst_mesh
         elif inst.opcode == PipelineInstType.RESHARD:
             m = inst.dst_mesh
+            if inst.src_mesh != inst.dst_mesh:
+                channels.setdefault(
+                    (inst.src_mesh, inst.dst_mesh), []).append(i)
         else:
             m = prev_stream
         m = m if 0 <= m < num_meshes else 0
@@ -203,7 +233,7 @@ def partition_streams(instructions: List[PipelineInstruction],
         if d:
             deps[i] = d
     return InstructionStreams(streams=streams, deps=deps,
-                              stream_of=stream_of)
+                              stream_of=stream_of, channels=channels)
 
 
 class DispatchRaceChecker:

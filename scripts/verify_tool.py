@@ -1,4 +1,4 @@
-"""Static plan verifier + repo lint front-end (ISSUE 8).
+"""Static plan verifier + repo lint front-end (ISSUE 8, ISSUE 13).
 
 Usage::
 
@@ -6,6 +6,8 @@ Usage::
     python scripts/verify_tool.py verify zero-delta [--dir DIR]
                                                     [--a KEY --b KEY] [--json]
     python scripts/verify_tool.py verify lint [--json]
+    python scripts/verify_tool.py modelcheck [--fixture PATH]
+                                             [--budget N] [--json]
 
 ``verify plan`` prints the cached :class:`PlanVerdict` of every lowered
 register-file program found in the compile cache's disk tier — WITHOUT
@@ -14,7 +16,44 @@ recompiling anything (the verifier caches verdicts under the
 back).  The cache directory comes from ``--dir``, else
 ``ALPA_TPU_CACHE_DIR``.  Default shows the newest verdict; ``--all``
 shows every cached one.  Exit status 1 when any shown verdict has
-errors.
+at least one error-severity finding.
+
+``verify plan --json`` emits the **stable** machine-readable schema
+``alpa-plan-verdict/v1``::
+
+    {"schema": "alpa-plan-verdict/v1",
+     "analyses": ["typing", "deadlock", "liveness", "structure",
+                  "model_check"],
+     "plans": [
+       {"key": "<cache key>",          # hex fingerprint-derived key
+        "mtime": 1712345678.9,         # verdict file mtime (epoch s)
+        "ok": true,                    # no error-severity findings
+        "verdict": {
+          "version": 3,                # ANALYSES_VERSION
+          "errors":   [{"analysis", "code", "message", "op"}...],
+          "warnings": [...same shape...],
+          "notes":    [...same shape...],
+          "stats": {..., "model_check": {  # present when the model
+            "states": N,                   # checker ran on this plan
+            "transitions": N, "por_commits": N,
+            "reduction_ratio": 0.33, "partial": false,
+            "semantics": {"buffered": "pass", "rendezvous": "pass"},
+            "declared_window": 2, "max_inflight": 2,
+            "retry_sites": {"<site>": {"classification":
+                "safe|unsafe|unreachable", "reasons": [...],
+                "hooks": N}, ...},
+            "counterexample": [...schedule lines...] or null}}}}]}
+
+Fields are only ever added, never renamed or removed, within /v1.
+
+``modelcheck`` runs the explicit-state model checker (ISSUE 13,
+``alpa_tpu.analysis.model_check``) standalone on a serialized plan
+fixture (format ``alpa-model-check-plan/v1``; default: the committed
+2-mesh overlap fixture under ``benchmark/results/``) and prints
+states explored, partial-order reduction ratio, per-property
+verdicts under both channel semantics, retry-site classification,
+and — on failure — the counterexample instruction schedule.  Exit
+status 1 on any error-severity finding.
 
 ``verify lint`` runs the AST repo lint (``alpa_tpu.analysis.lint``) —
 config-knob env/doc coverage, metric naming, deprecated-timer imports,
@@ -56,9 +95,15 @@ def cmd_plan(args):
                  f"written at compile time when verify_plans != off")
     shown = cached if args.all else cached[:1]
     if args.json:
-        print(json.dumps([{"key": e["key"], "mtime": e["mtime"],
-                           "verdict": e["verdict"].to_dict()}
-                          for e in shown], indent=2, sort_keys=True))
+        from alpa_tpu.analysis import plan_verifier
+        print(json.dumps(
+            {"schema": "alpa-plan-verdict/v1",
+             "analyses": list(plan_verifier.ANALYSES),
+             "plans": [{"key": e["key"], "mtime": e["mtime"],
+                        "ok": e["verdict"].ok,
+                        "verdict": e["verdict"].to_dict()}
+                       for e in shown]},
+            indent=2, sort_keys=True))
     else:
         for e in shown:
             print(f"== plan {e['key'][:16]}..  "
@@ -146,6 +191,36 @@ def cmd_zero_delta(args):
           f"{result['zero_bytes_saved_b']:.0f} B/device vs replicated")
 
 
+DEFAULT_FIXTURE = os.path.join(
+    REPO, "benchmark", "results", "model_check_fixture_plan.json")
+
+
+def cmd_modelcheck(args):
+    from alpa_tpu.analysis import model_check as mc
+    try:
+        model, hooks, window = mc.load_fixture(args.fixture)
+    except (OSError, ValueError, KeyError) as e:
+        sys.exit(f"cannot load model-check fixture {args.fixture}: {e}")
+    budget = args.budget or mc.DEFAULT_STATE_BUDGET
+    result = mc.check_model(model, hooks=hooks, overlap_window=window,
+                            budget=budget)
+    if args.json:
+        print(json.dumps(
+            {"schema": "alpa-model-check/v1",
+             "fixture": args.fixture,
+             "ok": result.ok,
+             "findings": [dict(f.to_dict(),
+                               severity=mc.severity_of(f.code))
+                          for f in result.findings],
+             "stats": result.stats},
+            indent=2, sort_keys=True, default=str))
+    else:
+        print(f"fixture: {args.fixture}")
+        print(result.format())
+    if not result.ok:
+        sys.exit(1)
+
+
 def cmd_lint(args):
     from alpa_tpu.analysis import lint
     violations = lint.run_lint()
@@ -187,6 +262,18 @@ def main():
     l = vsub.add_parser("lint", help="run the AST repo lint")
     l.add_argument("--json", action="store_true")
     l.set_defaults(fn=cmd_lint)
+    m = sub.add_parser(
+        "modelcheck",
+        help="model-check a serialized plan fixture "
+             "(alpa-model-check-plan/v1) standalone")
+    m.add_argument("--fixture", default=DEFAULT_FIXTURE,
+                   help="fixture JSON path (default: the committed "
+                        "2-mesh overlap fixture)")
+    m.add_argument("--budget", type=int, default=None,
+                   help="state-count budget (default: "
+                        "model_check.DEFAULT_STATE_BUDGET)")
+    m.add_argument("--json", action="store_true")
+    m.set_defaults(fn=cmd_modelcheck)
     args = parser.parse_args()
     args.fn(args)
 

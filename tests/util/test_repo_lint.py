@@ -24,11 +24,12 @@ def test_lint_rules_actually_detect(tmp_path):
     (pkg / "bad.py").write_text(
         "from alpa_tpu.timer import tracer\n"
         "REG.counter('bad_metric_name', 'description')\n"
+        "REG.gauge('alpa_scratch_gauge', 'well-named but undocumented')\n"
         "fault.fire('no_such_site')\n"
         "call_with_retry(f, site='also_missing')\n")
     codes = {v.code for v in lint.run_lint(root=str(tmp_path))}
     assert codes >= {"config-env", "config-doc", "metric-name",
-                     "timer-import", "fault-site"}, codes
+                     "metric-doc", "timer-import", "fault-site"}, codes
 
 
 def test_known_sites_registry_matches_docstring_table():
