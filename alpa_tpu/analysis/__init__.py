@@ -24,6 +24,12 @@ Two halves:
   every interleaving, overlap-window verification, and fault/retry
   safety classification.  Runs as the fifth ``verify_program``
   analysis behind ``global_config.verify_plans_model_check``.
+* :mod:`alpa_tpu.analysis.numerics` — a precision-flow abstract
+  interpretation (ISSUE 14) composing end-to-end quantization
+  error bounds per register slot (storage/accumulation dtypes,
+  provenance, lossy-hop lists) from the transfer codec's documented
+  ``ERROR_BOUND`` contract.  Runs as the sixth ``verify_program``
+  analysis behind ``global_config.verify_plans_numerics``.
 """
 from alpa_tpu.analysis.critical_path import (  # noqa: F401
     CriticalPathReport, PathStep, TimedOp, longest_path,
@@ -31,6 +37,8 @@ from alpa_tpu.analysis.critical_path import (  # noqa: F401
 from alpa_tpu.analysis.model_check import (  # noqa: F401
     ModelCheckResult, check_model, load_fixture, model_from_dict,
     model_to_dict)
+from alpa_tpu.analysis.numerics import (  # noqa: F401
+    NumericsResult, PrecisionValue, check_numerics)
 from alpa_tpu.analysis.plan_verifier import (  # noqa: F401
     Finding, PlanModel, PlanVerdict, PlanVerificationError,
     verify_model)

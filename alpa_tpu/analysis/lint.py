@@ -44,6 +44,16 @@ Checked invariants (one code per rule):
     ``fault.KNOWN_SITES`` — a typo'd site would otherwise never fire
     under any fault plan and never be caught.
 
+``codec-bound``
+    Any module defining a lossy codec (a module-level ``encode`` /
+    ``decode`` function pair) must declare a machine-readable
+    ``ERROR_BOUND`` dict (codec mode -> worst-case relative error) at
+    module level, with non-empty string keys.  The numerics
+    certification (``alpa_tpu.analysis.numerics``) composes exactly
+    these constants per lossy hop — a codec without a declared bound
+    (or with the bound hardcoded elsewhere) would silently escape the
+    end-to-end error accounting.
+
 Usage::
 
     from alpa_tpu.analysis import lint
@@ -313,6 +323,42 @@ def _check_fault_sites(root: str, rel: str, tree: ast.AST,
     return out
 
 
+# ---- rule: codec-bound ------------------------------------------------
+
+
+def _check_codec_bounds(rel: str, tree: ast.AST) -> List[Violation]:
+    """A module-level encode/decode pair marks a lossy codec module; it
+    must declare a module-level ``ERROR_BOUND`` dict literal with
+    non-empty string keys (values may be computed expressions like
+    ``1.0 / 254.0``).  Only ``tree.body`` is inspected — nested helper
+    defs (e.g. a local ``decode`` closure) are not codecs."""
+    top = {n.name for n in tree.body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    if not {"encode", "decode"} <= top:
+        return []
+    for n in tree.body:
+        if not isinstance(n, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "ERROR_BOUND"
+                   for t in n.targets):
+            continue
+        if (isinstance(n.value, ast.Dict) and n.value.keys
+                and all(isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)
+                        for k in n.value.keys)):
+            return []
+        return [Violation(
+            "codec-bound", rel, n.lineno,
+            "ERROR_BOUND must be a non-empty dict literal with string "
+            "codec-mode keys (the numerics analysis consumes it "
+            "machine-readably)")]
+    return [Violation(
+        "codec-bound", rel, 1,
+        "module defines a lossy encode/decode codec pair but declares "
+        "no module-level ERROR_BOUND dict — the numerics certification "
+        "cannot compose its round-trip error (see reshard_codec.py)")]
+
+
 # ---- driver -----------------------------------------------------------
 
 
@@ -333,6 +379,7 @@ def run_lint(root: Optional[str] = None) -> List[Violation]:
         out.extend(_check_metric_docs(rel, tree, obs_text))
         out.extend(_check_timer_imports(root, rel, tree))
         out.extend(_check_fault_sites(root, rel, tree, known))
+        out.extend(_check_codec_bounds(rel, tree))
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out
 

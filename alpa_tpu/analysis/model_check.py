@@ -940,9 +940,18 @@ def model_from_dict(d: Dict[str, Any]
     ops = []
     for od in d.get("ops", ()):
         kw = dict(od)
-        for k in ("reads", "writes", "kills", "in_avals", "out_avals"):
+        for k in ("reads", "writes", "kills"):
             kw[k] = tuple(tuple(x) if isinstance(x, list) else x
                           for x in kw.get(k, ()))
+        for k in ("in_avals", "out_avals"):
+            # avals are ((shape, dtype) | None) pairs whose shape must
+            # come back as a tuple (the typing pass compares tuples)
+            kw[k] = tuple(
+                (tuple(a[0]), a[1])
+                if isinstance(a, (list, tuple)) and len(a) == 2 and
+                isinstance(a[0], (list, tuple)) else
+                (tuple(a) if isinstance(a, list) else a)
+                for a in kw.get(k, ()))
         if kw.get("edge") is not None:
             kw["edge"] = tuple(kw["edge"])
         ops.append(OpModel(**kw))

@@ -4,7 +4,7 @@ register-file programs (ISSUE 8 tentpole).
 The pipeshard compiler's output is a *static* instruction program
 (RUN/RESHARD/FREE per mesh), which makes it exactly the artifact that
 can be verified before it ever touches hardware.  This module runs
-five analyses over the lowering's dataflow graph on EVERY
+six analyses over the lowering's dataflow graph on EVERY
 ``lower_to_register_file`` compile (gated by
 ``global_config.verify_plans`` = ``"error" | "warn" | "off"``,
 default ``"warn"``):
@@ -42,6 +42,15 @@ default ``"warn"``):
    hazard re-checking per schedule, in-flight-window verification, and
    a static fault/retry-safety classification installed into
    ``fault.call_with_retry``.
+6. **Numerics certification** (ISSUE 14,
+   :mod:`alpa_tpu.analysis.numerics`, gated by
+   ``global_config.verify_plans_numerics``) — a precision-flow
+   abstract interpretation composing the lossy codec's documented
+   error bounds end to end: proves weights and optimizer state never
+   cross a lossy hop anywhere along their flow, checks every value's
+   composed worst-case bound against ``numerics_error_budget``, flags
+   below-fp32 accumulation, and enumerates which collectives are
+   quantized vs full-precision.
 
 The result is a :class:`PlanVerdict` (errors / warnings / stats),
 cached in the compile cache (namespace ``plan_verdict``, keyed by the
@@ -67,16 +76,18 @@ __all__ = [
     "verify_model", "verify_program", "verify_edge",
 ]
 
-#: the five analyses, in report order
+#: the six analyses, in report order
 ANALYSES = ("typing", "deadlock", "liveness", "structure",
-            "model_check")
+            "model_check", "numerics")
 
 #: bump when an analysis changes meaning — invalidates cached verdicts
 #: (v2: launch-placed slots are accounted at per-device bytes derived
 #: from their static sharding, so ZeRO-sharded optimizer state shows
 #: the ~dp× reduction in ``peak_bytes``; v3: the ISSUE-13 model checker
-#: joins as the fifth analysis and verdicts grow a ``notes`` severity)
-ANALYSES_VERSION = 3
+#: joins as the fifth analysis and verdicts grow a ``notes`` severity;
+#: v4: the ISSUE-14 numerics certification joins as the sixth analysis
+#: and slots/ops grow provenance/codec/precision facts)
+ANALYSES_VERSION = 4
 
 _REG = _tmetrics.get_registry()
 _PEAK_BYTES = _REG.gauge(
@@ -144,6 +155,8 @@ class SlotModel:
     preplaced: bool = False     # placed by the driver at launch
     protected: bool = False     # program output — never freed by design
     opt_state: bool = False     # optimizer-state leaf (ZeRO target)
+    provenance: str = ""        # param|opt_state|gradient|activation
+                                # (numerics seed, from invar_paths)
 
 
 @dataclasses.dataclass
@@ -166,6 +179,9 @@ class OpModel:
     in_avals: Tuple[Any, ...] = ()
     out_avals: Tuple[Any, ...] = ()
     label: str = ""
+    codec: Optional[str] = None             # quantized RESHARD wire mode
+    # RUN eqn-classification facts (eqn_classify; numerics analysis)
+    precision: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -277,6 +293,17 @@ class PlanVerdict:
                 + "  ".join(f"{k}={v}" for k, v in sorted(sem.items()))
                 + f"  states={mc.get('states', 0)}"
                   f"  reduction_ratio={mc.get('reduction_ratio', 0.0)}")
+        num = st.get("numerics") if st else None
+        if num:
+            lossy = num.get("lossy_edges", {})
+            lines.append(
+                "numerics: "
+                + ("no lossy hops" if not lossy else
+                   "  ".join(f"{k}={v}"
+                             for k, v in sorted(lossy.items())))
+                + f"  max_error_bound="
+                  f"{num.get('max_error_bound', 0.0):.6g}"
+                  f"  budget={num.get('budget', 0.0):.6g}")
         for title, items in (("errors", self.errors),
                              ("warnings", self.warnings),
                              ("notes", self.notes)):
@@ -332,7 +359,8 @@ def build_model(instructions: Sequence[Any],
                 recs: Sequence[Dict[str, Any]],
                 protected_keys=frozenset(),
                 mode: str = "registers",
-                opt_state_keys=frozenset()) -> PlanModel:
+                opt_state_keys=frozenset(),
+                provenance_keys=None) -> PlanModel:
     """Assemble a :class:`PlanModel` from the lowering's inputs: the
     emitted instruction list, the slot table, the launch-placed keys,
     and the phase-1 per-instruction records (kind / footprint / edge /
@@ -358,7 +386,8 @@ def build_model(instructions: Sequence[Any],
             full_nbytes=nbytes,
             preplaced=preplaced,
             protected=key in protected_keys,
-            opt_state=key in opt_state_keys)
+            opt_state=key in opt_state_keys,
+            provenance=(provenance_keys or {}).get(key, ""))
 
     num_meshes = 1
     for inst in instructions:
@@ -382,12 +411,15 @@ def build_model(instructions: Sequence[Any],
                 _aval_of(v)[:2] for v in getattr(ex, "invars", ()))
             op.out_avals = tuple(
                 _aval_of(v)[:2] for v in getattr(ex, "outvars", ()))
+            op.precision = r.get("precision")
         elif kind == "RESHARD":
             op.edge = r.get("edge")
             op.cross = bool(r.get("cross", False))
             t = r.get("transfer")
             op.strategy = _strategy_of(t)
             op.weight = inst.var_key[1] < 0
+            if op.strategy == "quantized":
+                op.codec = r.get("codec") or getattr(t, "mode", None)
             op.groupable = bool(r.get("groupable", True))
             op.nbytes = int(getattr(t, "nbytes", 0) or
                             _aval_of(inst.var_key[0])[2])
@@ -848,14 +880,18 @@ def verify_model(model: PlanModel,
                  hooks: Optional[Sequence[Any]] = None,
                  model_check: bool = False,
                  overlap_window: int = 0,
-                 model_check_budget: Optional[int] = None
+                 model_check_budget: Optional[int] = None,
+                 numerics: bool = False,
+                 numerics_budget: Optional[float] = None
                  ) -> PlanVerdict:
     """Run the analyses over a plan model; pure function of its
     inputs (no metrics, no cache — see :func:`verify_program` for the
     compile-time wrapper).  The fifth analysis (the ISSUE-13 explicit
     state model checker) is opt-in via ``model_check=True`` — it
     explores every stream interleaving, so the caller decides whether
-    this plan is worth the state-space walk."""
+    this plan is worth the state-space walk.  The sixth (the ISSUE-14
+    numerics certification) is opt-in via ``numerics=True`` with a
+    per-tensor relative-error ``numerics_budget``."""
     t0 = time.perf_counter()
     findings: List[Finding] = []
     findings += check_typing(model)
@@ -876,12 +912,23 @@ def verify_model(model: PlanModel,
                        for f in mc.findings}
         mc_stats = mc.stats
 
+    num_stats = None
+    num_severity: Dict[str, str] = {}
+    if numerics:
+        from alpa_tpu.analysis import numerics as _num
+        nr = _num.check_numerics(model, hooks=hooks,
+                                 budget=numerics_budget)
+        findings += nr.findings
+        num_severity = {f.code: _num.severity_of(f.code)
+                        for f in nr.findings}
+        num_stats = nr.stats
+
     warning_codes = ("liveness.leak", "liveness.dead-store",
                      "liveness.peak-exceeds-memory",
                      "deadlock.channel-reorder")
     verdict = PlanVerdict()
     for f in findings:
-        sev = mc_severity.get(f.code) or (
+        sev = mc_severity.get(f.code) or num_severity.get(f.code) or (
             "warning" if f.code in warning_codes else "error")
         {"error": verdict.errors, "warning": verdict.warnings,
          "note": verdict.notes}[sev].append(f)
@@ -902,14 +949,21 @@ def verify_model(model: PlanModel,
     }
     if mc_stats is not None:
         verdict.stats["model_check"] = mc_stats
+    if num_stats is not None:
+        verdict.stats["numerics"] = num_stats
     return verdict
 
 
 def _cache_key(cache, fingerprint: str, mode: str,
-               model_checked: bool = False) -> str:
+               model_checked: bool = False,
+               numerics: bool = False,
+               numerics_budget: Optional[float] = None) -> str:
+    # the budget participates in findings (budget-exceeded), so it must
+    # key the cache alongside the on/off bit
+    num = f"num1b{numerics_budget!r}" if numerics else "num0"
     return cache.make_key(
         "plan_verdict", [f"analyses_v{ANALYSES_VERSION}", mode,
-                         f"mc{int(model_checked)}", fingerprint])
+                         f"mc{int(model_checked)}", num, fingerprint])
 
 
 def _model_check_enabled(n_ops: int) -> bool:
@@ -932,7 +986,8 @@ def verify_program(instructions: Sequence[Any],
                    preplaced_shardings: Dict[Any, Any],
                    recs: Sequence[Dict[str, Any]],
                    protected_keys=frozenset(),
-                   opt_state_keys=frozenset()) -> PlanVerdict:
+                   opt_state_keys=frozenset(),
+                   provenance_keys=None) -> PlanVerdict:
     """Compile-time entry point, called by ``lower_to_register_file``
     for every lowered program when ``global_config.verify_plans`` is
     not ``"off"``.
@@ -949,10 +1004,15 @@ def verify_program(instructions: Sequence[Any],
 
     fingerprint = prog.fingerprint()
     do_mc = _model_check_enabled(len(instructions))
+    do_num = getattr(global_config, "verify_plans_numerics",
+                     "warn") != "off"
+    num_budget = float(getattr(global_config, "numerics_error_budget",
+                               0.05))
     cache = _cc.get_compile_cache() if _cc.cache_enabled() else None
     verdict = None
     if cache is not None:
-        key = _cache_key(cache, fingerprint, prog.mode, do_mc)
+        key = _cache_key(cache, fingerprint, prog.mode, do_mc,
+                         numerics=do_num, numerics_budget=num_budget)
         hit = cache.get("plan_verdict", key)
         if isinstance(hit, dict) and \
                 hit.get("version") == ANALYSES_VERSION:
@@ -962,12 +1022,14 @@ def verify_program(instructions: Sequence[Any],
                             preplaced_shardings, recs,
                             protected_keys=protected_keys,
                             mode=prog.mode,
-                            opt_state_keys=opt_state_keys)
+                            opt_state_keys=opt_state_keys,
+                            provenance_keys=provenance_keys)
         verdict = verify_model(
             model, hooks=prog.hooks, model_check=do_mc,
             overlap_window=getattr(prog, "overlap_window", 0) or 0,
             model_check_budget=getattr(
-                global_config, "model_check_state_budget", None))
+                global_config, "model_check_state_budget", None),
+            numerics=do_num, numerics_budget=num_budget)
         if cache is not None:
             cache.put("plan_verdict", key, verdict.to_dict())
 
@@ -1007,6 +1069,13 @@ def verify_program(instructions: Sequence[Any],
     else:
         _mc.export_metrics({}, "skipped")
 
+    # numerics gauges replay from the deterministic stats on cache
+    # hits too, so warm restarts export the cold compile's values
+    num_stats = verdict.stats.get("numerics")
+    if num_stats:
+        from alpa_tpu.analysis import numerics as _num
+        _num.export_metrics(num_stats)
+
     _apply_policy(verdict, fingerprint)
     return verdict
 
@@ -1014,6 +1083,20 @@ def verify_program(instructions: Sequence[Any],
 def _apply_policy(verdict: PlanVerdict, fingerprint: str) -> None:
     from alpa_tpu.global_env import global_config
     policy = getattr(global_config, "verify_plans", "warn")
+    # numerics-error policy is independent of verify_plans: a lossy
+    # weight path / blown budget blocks launch even when the general
+    # verifier is only warning
+    if getattr(global_config, "verify_plans_numerics", "warn") == \
+            "error":
+        num_errors = [f for f in verdict.errors
+                      if f.analysis == "numerics"]
+        if num_errors:
+            raise PlanVerificationError(
+                "numerics certification failed "
+                f"(plan {fingerprint[:12]}):\n"
+                + "\n".join(f"  [{f.code}] {f.message}"
+                            for f in num_errors[:10]),
+                verdict)
     if verdict.errors and policy == "error":
         raise PlanVerificationError(
             "static plan verification failed "
@@ -1069,9 +1152,11 @@ def load_cached_verdicts(cache=None) -> List[Dict[str, Any]]:
 
 def verify_edge(shape: Tuple[int, ...], dtype: str, src_sharding,
                 dst_sharding, weight: bool = False) -> List[str]:
-    """Typing verdict for one cross-mesh edge, independent of a full
-    program: endpoint byte match, sharding coverage, and quantized
-    codec legality.  Returns human-readable verdict lines appended to
+    """Typing + numerics verdict for one cross-mesh edge, independent
+    of a full program: endpoint byte match, sharding coverage,
+    quantized codec legality, and the codec's documented error bound
+    (block size, per-hop bound, and the composed single-hop plan
+    bound).  Returns human-readable verdict lines appended to
     ``reshard_tool.py plan --verify``'s candidate table."""
     import numpy as np
     lines: List[str] = []
@@ -1096,6 +1181,15 @@ def verify_edge(shape: Tuple[int, ...], dtype: str, src_sharding,
     elif dtype in ("float32", "bfloat16", "float16"):
         lines.append("typing: activation edge — quantized codec "
                      "eligible when enabled")
+        # numerics: the codec's machine-readable error contract per
+        # candidate mode, composed over this single hop (ISSUE 14)
+        from alpa_tpu.pipeline_parallel import reshard_codec as _codec
+        for mode in sorted(_codec.ERROR_BOUND):
+            bound = _codec.ERROR_BOUND[mode]
+            lines.append(
+                f"numerics: codec {mode} block={_codec.BLOCK} "
+                f"documented bound {bound:.6g} of blockmax; composed "
+                f"plan bound after this hop {bound:.6g}")
     else:
         lines.append(f"typing: non-float dtype {dtype} — quantized "
                      "codec ineligible")

@@ -133,6 +133,21 @@ class GlobalConfig:
         # note + the `partial` stat), never an error.
         self.model_check_state_budget = int(os.environ.get(
             "ALPA_TPU_MODEL_CHECK_BUDGET", "50000"))
+        # Sixth analysis (ISSUE 14): numerics certification — a
+        # precision-flow abstract interpretation composing the lossy
+        # transfer codec's documented error bounds end to end.  "warn"
+        # (default) reports findings through the verify_plans policy;
+        # "error" blocks _launch with PlanVerificationError on any
+        # numerics.* error finding even when verify_plans itself only
+        # warns; "off" skips the analysis.
+        self.verify_plans_numerics = os.environ.get(
+            "ALPA_TPU_VERIFY_NUMERICS", "warn")
+        # Per-tensor worst-case relative-error budget (fraction of the
+        # codec's block max) the numerics analysis certifies every
+        # value's composed bound against; crossing it raises a
+        # numerics.budget-exceeded finding.
+        self.numerics_error_budget = float(os.environ.get(
+            "ALPA_TPU_NUMERICS_ERROR_BUDGET", "0.05"))
         # Whether pipeshard runtime overlaps resharding with compute by
         # issuing transfers as soon as producers finish.  This is the
         # gate for the "overlap" dispatch mode under

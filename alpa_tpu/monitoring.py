@@ -185,6 +185,10 @@ def dump_debug_info(executable, dump_dir: str):
     # channel-semantics verdicts, retry-site classification
     if hasattr(executable, "get_model_check_text"):
         write("model_check.txt", executable.get_model_check_text())
+    # numerics certification (ISSUE 14): per-output composed error
+    # bounds, lossy-hop enumeration, budget verdicts
+    if hasattr(executable, "get_numerics_text"):
+        write("numerics.txt", executable.get_numerics_text())
     # post-step perf analysis (ISSUE 9): critical path, bubbles, MFU
     if hasattr(executable, "get_perf_report_text"):
         write("perf_report.txt", executable.get_perf_report_text())

@@ -932,6 +932,32 @@ class PipeshardDriverExecutable:
                 for mesh_id, _sh in places:
                     opt_state_keys.add((v, -1, mesh_id))
 
+        # provenance seed for the numerics certification (ISSUE 14):
+        # classify every launch-placed value by its pytree path so the
+        # precision-flow analysis can prove params / opt state never
+        # cross a lossy hop anywhere along their flow
+        provenance_keys: Dict[Tuple[Var, int, int], str] = {}
+        if self.invar_paths:
+            from alpa_tpu.shard_parallel.auto_sharding import (
+                is_opt_state_path, is_param_path)
+            for v, places in self.input_place.items():
+                path = self.invar_paths.get(v, "")
+                if is_opt_state_path(path):
+                    prov = "opt_state"
+                elif is_param_path(path):
+                    prov = "param"
+                else:
+                    prov = "activation"
+                if self.batch_invars[ginvar_idx[v]]:
+                    for mesh_id, _sh in places:
+                        for mb in range(n_mb):
+                            provenance_keys[(v, mb, mesh_id)] = prov
+                else:
+                    for mesh_id, _sh in places:
+                        provenance_keys[(v, -1, mesh_id)] = prov
+        for v, mesh_id, _aval, _sh in self.acc_allocs:
+            provenance_keys[(v, -1, mesh_id)] = "gradient"
+
         # program outputs are never FREEd by design — the plan
         # verifier's leak analysis must not flag them (ISSUE 8)
         protected = set()
@@ -948,7 +974,8 @@ class PipeshardDriverExecutable:
                                       overlap_window=self._overlap_window(),
                                       protected_keys=frozenset(protected),
                                       opt_state_keys=frozenset(
-                                          opt_state_keys))
+                                          opt_state_keys),
+                                      provenance_keys=provenance_keys)
         self._register_programs[mode] = prog
         if mode == "registers":
             self._register_program = prog
@@ -1377,6 +1404,26 @@ class PipeshardDriverExecutable:
         mc_findings = [f for f in verdict.findings()
                        if f.analysis == "model_check"]
         return _mc.format_stats(mc_stats, mc_findings)
+
+    def get_numerics_text(self) -> str:
+        """``numerics.txt`` content for dump_debug_info (ISSUE 14): the
+        numerics certification's per-output bound table + findings for
+        the lowered plan."""
+        verdict = None
+        try:
+            verdict = self.get_plan_verdict()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("get_numerics_text failed")
+        if verdict is None:
+            return ("numerics: (not available — verify_plans=off, "
+                    "lowering failed, or launch not register-eligible)")
+        num_stats = verdict.stats.get("numerics")
+        if not num_stats:
+            return "numerics: (not run — verify_plans_numerics=off)"
+        from alpa_tpu.analysis import numerics as _num
+        num_findings = [f for f in verdict.findings()
+                        if f.analysis == "numerics"]
+        return _num.format_numerics(num_stats, num_findings)
 
     def get_perf_report(self):
         """Post-step :class:`~alpa_tpu.telemetry.perf.StepPerfReport`

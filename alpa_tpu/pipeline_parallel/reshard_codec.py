@@ -41,6 +41,20 @@ logger = logging.getLogger(__name__)
 # elements per scaling block; one fp32 scale crosses per block
 BLOCK = 256
 
+#: Machine-readable round-trip error contract, per codec mode: the
+#: worst-case element error as a fraction of the block's max magnitude
+#: (the docstring bounds above, as constants).  Single source of truth
+#: for every consumer — the numerics certification analysis
+#: (``alpa_tpu.analysis.numerics``) composes exactly these constants
+#: per lossy hop, ``plan_verifier.verify_edge`` prints them, and the
+#: codec contract tests pin the codec against them.  The ``codec-bound``
+#: repo-lint rule requires any module defining a lossy encode/decode
+#: pair to declare this dict.
+ERROR_BOUND = {
+    "int8": 1.0 / 254.0,    # scale/2 = amax_block/254
+    "fp8": 0.07,            # e4m3 rounding, documented 7% of blockmax
+}
+
 # dtypes the codec accepts; everything else passes through untouched
 _ELIGIBLE_DTYPES = ("float32", "bfloat16")
 

@@ -614,6 +614,9 @@ class OpHook:
     fault_site: Optional[str] = None      # fault.py site name
     fault_infos: Tuple[Any, ...] = ()     # one info dict per member
     idempotent: bool = True               # retry semantics (donation)
+    # RUN eqn-classification facts (ISSUE 14 numerics certification):
+    # matmul/reduce/cast counts + narrowest accumulation dtype
+    precision: Optional[Any] = None
     # flat instruction indices this op replays: (idx,) for singletons,
     # every folded member for batched groups — the plan verifier
     # (ISSUE 8) checks the footprint above equals the union of the
@@ -1163,6 +1166,7 @@ def lower_to_register_file(
         overlap_window: int = 4,
         protected_keys=frozenset(),
         opt_state_keys=frozenset(),
+        provenance_keys=None,
 ) -> RegisterFileProgram:
     """Lower the emitted instruction list into a :class:`RegisterFileProgram`.
 
@@ -1216,6 +1220,24 @@ def lower_to_register_file(
     by_opcode = {"RUN": 0, "RESHARD": 0, "FREE": 0}
     n_fixups = 0
 
+    # numerics certification (ISSUE 14): classify each stage's
+    # matmul/reduce/cast population once per executable, only when the
+    # verifier will actually consume it (both knobs on)
+    want_numerics = (
+        getattr(global_config, "verify_plans", "warn") != "off" and
+        getattr(global_config, "verify_plans_numerics", "warn") != "off")
+    _prec_cache: Dict[int, Any] = {}
+
+    def _precision_of(ex):
+        if not want_numerics:
+            return None
+        key = id(ex)
+        if key not in _prec_cache:
+            from alpa_tpu.shard_parallel.eqn_classify import (
+                classify_stage_precision)
+            _prec_cache[key] = classify_stage_precision(ex)
+        return _prec_cache[key]
+
     for inst in instructions:
         if inst.opcode == PipelineInstType.RUN:
             by_opcode["RUN"] += 1
@@ -1250,6 +1272,7 @@ def lower_to_register_file(
                 # interpreter uses for this instruction (ISSUE 6)
                 "site": "stage_launch",
                 "finfo": {"stage": inst.info, "mesh_id": inst.dst_mesh},
+                "precision": _precision_of(ex),
                 "idem": not donated,
                 "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "
@@ -1292,7 +1315,11 @@ def lower_to_register_file(
                 "site": "cross_mesh_send",
                 "finfo": {"var": str(v), "src_mesh": inst.src_mesh,
                           "dst_mesh": inst.dst_mesh,
-                          "strategy": strategy},
+                          "strategy": strategy,
+                          "codec": getattr(t, "mode", None)
+                          if strategy == "quantized" else None},
+                "codec": getattr(t, "mode", None)
+                if strategy == "quantized" else None,
                 "idem": True,
                 "line": (f"RESHARD {inst.var_key} {inst.src_mesh}->"
                          f"{inst.dst_mesh} slot {ss}->{ds} fast={t.fast}" +
@@ -1339,6 +1366,7 @@ def lower_to_register_file(
                       fault_site=site,
                       fault_infos=(r["finfo"],) if site else (),
                       idempotent=r.get("idem", True),
+                      precision=r.get("precision"),
                       members=(idx,))
 
     def _group_hook(mem_idx, kind="exec", label=None):
@@ -1565,7 +1593,8 @@ def lower_to_register_file(
         prog.verdict = plan_verifier.verify_program(
             instructions, prog, preplaced_shardings, recs,
             protected_keys=protected_keys,
-            opt_state_keys=opt_state_keys)
+            opt_state_keys=opt_state_keys,
+            provenance_keys=provenance_keys)
     return prog
 
 

@@ -1,0 +1,132 @@
+"""Jaxpr-level precision classification for stage executables
+(ISSUE 14; consumed by :mod:`alpa_tpu.analysis.numerics`).
+
+Walks a stage's closed jaxpr (recursing into sub-jaxprs carried in eqn
+params — ``remat``, ``scan``, ``cond``, ``pjit`` bodies) and types the
+operations that decide numerical fate: contractions
+(``dot_general`` / ``conv_general_dilated``), reductions
+(``reduce_sum`` / ``reduce_prod`` / ``add_any`` / ``cumsum`` /
+``reduce_window_sum``), and dtype casts (``convert_element_type``).
+The result is a small deterministic JSON-able dict the plan verifier
+attaches to each RUN op (``OpModel.precision``) — notably
+``min_accum`` (the narrowest accumulation dtype any contraction or
+reduction in the stage uses) and ``below_fp32_accum`` (True when a
+reduction accumulates below fp32, the
+``numerics.bf16-accumulation`` trigger per "Mixed Precision Training",
+Micikevicius et al., PAPERS.md: partial sums need fp32 even when
+storage is bf16/fp16).
+"""
+from typing import Any, Dict, Optional
+
+__all__ = ["classify_stage_precision", "classify_jaxpr_precision"]
+
+# wider-is-better rank for accumulation dtypes; unknown dtypes (ints,
+# bools, tokens) don't participate in min_accum
+_DTYPE_RANK = {
+    "float64": 4,
+    "float32": 3,
+    "bfloat16": 2,
+    "float16": 2,
+    "float8_e4m3fn": 1,
+    "float8_e5m2": 1,
+    "float8_e4m3": 1,
+}
+
+_CONTRACTIONS = ("dot_general", "conv_general_dilated")
+_REDUCTIONS = ("reduce_sum", "reduce_prod", "add_any", "cumsum",
+               "reduce_window_sum")
+_CASTS = ("convert_element_type",)
+
+
+def _rank(dtype: str) -> Optional[int]:
+    return _DTYPE_RANK.get(str(dtype))
+
+
+def _out_dtype(eqn) -> str:
+    try:
+        return str(eqn.outvars[0].aval.dtype)
+    except Exception:  # pylint: disable=broad-except
+        return ""
+
+
+def _accum_dtype(eqn) -> str:
+    """The dtype an eqn accumulates in: an explicit
+    ``preferred_element_type`` when the contraction declares one, else
+    the output dtype (XLA accumulates reductions in the result type
+    unless told otherwise)."""
+    pet = eqn.params.get("preferred_element_type") \
+        if hasattr(eqn, "params") else None
+    if pet is not None:
+        return str(pet)
+    return _out_dtype(eqn)
+
+
+def _walk(jaxpr, acc: Dict[str, Any]) -> None:
+    for eqn in jaxpr.eqns:
+        prim = getattr(eqn.primitive, "name", str(eqn.primitive))
+        if prim in _CONTRACTIONS:
+            acc["n_matmul"] += 1
+            _fold_accum(acc, _accum_dtype(eqn), reduction=False)
+        elif prim in _REDUCTIONS:
+            acc["n_reduce"] += 1
+            _fold_accum(acc, _accum_dtype(eqn), reduction=True)
+        elif prim in _CASTS:
+            acc["n_cast"] += 1
+        # recurse into sub-jaxprs (remat/scan/cond/pjit bodies)
+        for v in getattr(eqn, "params", {}).values():
+            for sub in _sub_jaxprs(v):
+                _walk(sub, acc)
+
+
+def _sub_jaxprs(param):
+    out = []
+    stack = [param]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+            continue
+        inner = getattr(v, "jaxpr", None)
+        if inner is not None and hasattr(inner, "eqns"):
+            out.append(inner)           # ClosedJaxpr
+        elif hasattr(v, "eqns"):
+            out.append(v)               # bare Jaxpr
+    return out
+
+
+def _fold_accum(acc: Dict[str, Any], dtype: str,
+                reduction: bool) -> None:
+    r = _rank(dtype)
+    if r is None:
+        return
+    cur = _rank(acc["min_accum"]) if acc["min_accum"] else None
+    if cur is None or r < cur:
+        acc["min_accum"] = str(dtype)
+    if reduction and r < _DTYPE_RANK["float32"]:
+        acc["below_fp32_accum"] = True
+
+
+def classify_jaxpr_precision(closed_jaxpr) -> Dict[str, Any]:
+    """Classify one closed jaxpr's precision-relevant eqn population.
+    Deterministic and JSON-able (it joins the cached plan verdict)."""
+    acc: Dict[str, Any] = {
+        "n_matmul": 0, "n_reduce": 0, "n_cast": 0,
+        "min_accum": "", "below_fp32_accum": False,
+    }
+    _walk(closed_jaxpr.jaxpr, acc)
+    return acc
+
+
+def classify_stage_precision(ex) -> Optional[Dict[str, Any]]:
+    """:func:`classify_jaxpr_precision` over a
+    :class:`~alpa_tpu.pipeline_parallel.pipeshard_executable.StageExecutable`'s
+    computation; None when the executable carries no recoverable jaxpr
+    (synthetic test stages) — the numerics analysis then skips the
+    accumulation checks for that RUN."""
+    try:
+        comp = getattr(ex, "comp", None)
+        if comp is None:
+            return None
+        return classify_jaxpr_precision(comp.closed_jaxpr())
+    except Exception:  # pylint: disable=broad-except
+        return None

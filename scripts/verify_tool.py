@@ -8,6 +8,8 @@ Usage::
     python scripts/verify_tool.py verify lint [--json]
     python scripts/verify_tool.py modelcheck [--fixture PATH]
                                              [--budget N] [--json]
+    python scripts/verify_tool.py numerics [--fixture PATH]
+                                           [--error-budget F] [--json]
 
 ``verify plan`` prints the cached :class:`PlanVerdict` of every lowered
 register-file program found in the compile cache's disk tier — WITHOUT
@@ -54,6 +56,30 @@ states explored, partial-order reduction ratio, per-property
 verdicts under both channel semantics, retry-site classification,
 and — on failure — the counterexample instruction schedule.  Exit
 status 1 on any error-severity finding.
+
+``numerics`` runs the numerics certification (ISSUE 14,
+``alpa_tpu.analysis.numerics``) standalone on a serialized plan
+fixture (same ``alpa-model-check-plan/v1`` serialization; default: the
+committed 2-mesh quantized-edge fixture under ``benchmark/results/``)
+and prints the per-output composed error-bound table, the lossy-hop
+enumeration, and every ``numerics.*`` finding.  Exit status 1 on any
+error-severity finding.  ``--json`` emits the **stable** schema
+``alpa-numerics/v1``::
+
+    {"schema": "alpa-numerics/v1",
+     "fixture": "<path>",
+     "ok": true,                       # no error-severity findings
+     "findings": [{"analysis", "code", "message", "op",
+                   "severity"}...],
+     "stats": {"max_error_bound": 0.0079,
+               "lossy_edges": {"int8": 2},     # hops by codec kind
+               "n_lossy_collectives": 2, "n_bf16_reductions": 0,
+               "bound_table": [{"slot", "var", "provenance",
+                                "storage", "accum", "bound",
+                                "hops"}...],   # program outputs
+               "budget": 0.05, "n_tracked": N, "seconds": 0.001}}
+
+Fields are only ever added, never renamed or removed, within /v1.
 
 ``verify lint`` runs the AST repo lint (``alpa_tpu.analysis.lint``) —
 config-knob env/doc coverage, metric naming, deprecated-timer imports,
@@ -193,6 +219,8 @@ def cmd_zero_delta(args):
 
 DEFAULT_FIXTURE = os.path.join(
     REPO, "benchmark", "results", "model_check_fixture_plan.json")
+DEFAULT_NUMERICS_FIXTURE = os.path.join(
+    REPO, "benchmark", "results", "numerics_fixture_plan.json")
 
 
 def cmd_modelcheck(args):
@@ -211,6 +239,32 @@ def cmd_modelcheck(args):
              "ok": result.ok,
              "findings": [dict(f.to_dict(),
                                severity=mc.severity_of(f.code))
+                          for f in result.findings],
+             "stats": result.stats},
+            indent=2, sort_keys=True, default=str))
+    else:
+        print(f"fixture: {args.fixture}")
+        print(result.format())
+    if not result.ok:
+        sys.exit(1)
+
+
+def cmd_numerics(args):
+    from alpa_tpu.analysis import model_check as mc
+    from alpa_tpu.analysis import numerics as num
+    try:
+        model, hooks, _window = mc.load_fixture(args.fixture)
+    except (OSError, ValueError, KeyError) as e:
+        sys.exit(f"cannot load plan fixture {args.fixture}: {e}")
+    result = num.check_numerics(model, hooks=hooks,
+                                budget=args.error_budget)
+    if args.json:
+        print(json.dumps(
+            {"schema": "alpa-numerics/v1",
+             "fixture": args.fixture,
+             "ok": result.ok,
+             "findings": [dict(f.to_dict(),
+                               severity=num.severity_of(f.code))
                           for f in result.findings],
              "stats": result.stats},
             indent=2, sort_keys=True, default=str))
@@ -274,6 +328,18 @@ def main():
                         "model_check.DEFAULT_STATE_BUDGET)")
     m.add_argument("--json", action="store_true")
     m.set_defaults(fn=cmd_modelcheck)
+    u = sub.add_parser(
+        "numerics",
+        help="run the numerics certification on a serialized plan "
+             "fixture (alpa-model-check-plan/v1) standalone")
+    u.add_argument("--fixture", default=DEFAULT_NUMERICS_FIXTURE,
+                   help="fixture JSON path (default: the committed "
+                        "2-mesh quantized-edge fixture)")
+    u.add_argument("--error-budget", type=float, default=None,
+                   help="per-tensor relative-error budget (default: "
+                        "numerics.DEFAULT_ERROR_BUDGET)")
+    u.add_argument("--json", action="store_true")
+    u.set_defaults(fn=cmd_numerics)
     args = parser.parse_args()
     args.fn(args)
 

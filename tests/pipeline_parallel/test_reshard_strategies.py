@@ -287,6 +287,46 @@ class TestCodecContract:
         bound = _block_bounds(xf, 1 / 250 + 1 / 128) + 1e-6
         assert (np.abs(y - xf) <= bound).all()
 
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_error_bound_table_is_the_contract(self, seed):
+        """ISSUE 14 satellite: the ``codec.ERROR_BOUND`` table — the
+        single source of truth the numerics certification composes —
+        must hold property-style on adversarial inputs: exact zeros,
+        denormals, ragged tails, bf16 payloads."""
+        rng = np.random.default_rng(seed)
+        modes = ("int8",) + (("fp8",) if codec.have_fp8() else ())
+        for mode in modes:
+            frac = codec.ERROR_BOUND[mode]
+            for shape in [(codec.BLOCK,), (codec.BLOCK + 3,), (5,),
+                          (2, codec.BLOCK - 1)]:
+                n = int(np.prod(shape))
+                x = (rng.standard_normal(n) *
+                     rng.uniform(1e-3, 1e3)).astype(np.float32)
+                # sprinkle exact zeros and denormals into every block
+                x[rng.integers(0, n, size=max(1, n // 7))] = 0.0
+                x[rng.integers(0, n, size=max(1, n // 11))] = 1e-40
+                x = x.reshape(shape)
+                q, s = codec.encode(jnp.asarray(x), mode)
+                y = np.asarray(codec.decode(q, s, shape, np.float32,
+                                            mode))
+                bound = _block_bounds(x, frac) + 1e-7
+                err = np.abs(np.ravel(y) - np.ravel(x))
+                assert (err <= bound).all(), (mode, shape)
+            # bf16 payload: table bound + one bf16 rounding step
+            xb = (rng.standard_normal(300) * 2).astype(jnp.bfloat16)
+            q, s = codec.encode(jnp.asarray(xb), mode)
+            yb = np.asarray(codec.decode(q, s, (300,), jnp.bfloat16,
+                                         mode)).astype(np.float32)
+            xf = np.asarray(xb).astype(np.float32)
+            bound = _block_bounds(xf, frac + 1 / 128) + 1e-6
+            assert (np.abs(yb - xf) <= bound).all(), mode
+
+    def test_error_bound_values_pinned(self):
+        """The documented bounds the plan verifier composes: int8 is
+        blockmax/254 (symmetric int8 over 127 steps), fp8 is 7%."""
+        assert codec.ERROR_BOUND["int8"] == 1.0 / 254.0
+        assert codec.ERROR_BOUND["fp8"] == 0.07
+
     def test_eligibility_gating(self):
         global_config.reshard_quantize_min_bytes = 65536
         big, small = _Aval((256, 256)), _Aval((8, 8))

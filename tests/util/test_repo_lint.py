@@ -27,9 +27,16 @@ def test_lint_rules_actually_detect(tmp_path):
         "REG.gauge('alpa_scratch_gauge', 'well-named but undocumented')\n"
         "fault.fire('no_such_site')\n"
         "call_with_retry(f, site='also_missing')\n")
+    (pkg / "badcodec.py").write_text(
+        "def encode(x, mode):\n"
+        "    return x\n"
+        "\n"
+        "def decode(q, s, shape, dtype, mode):\n"
+        "    return q\n")
     codes = {v.code for v in lint.run_lint(root=str(tmp_path))}
     assert codes >= {"config-env", "config-doc", "metric-name",
-                     "metric-doc", "timer-import", "fault-site"}, codes
+                     "metric-doc", "timer-import", "fault-site",
+                     "codec-bound"}, codes
 
 
 def test_known_sites_registry_matches_docstring_table():
