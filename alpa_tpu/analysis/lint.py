@@ -44,6 +44,14 @@ Checked invariants (one code per rule):
     ``fault.KNOWN_SITES`` — a typo'd site would otherwise never fire
     under any fault plan and never be caught.
 
+``finding-code-doc``
+    Every finding code string literal emitted by the static analyses
+    under ``alpa_tpu/analysis/*`` (``typing.*``, ``deadlock.*``,
+    ``liveness.*``, ``structure.*``, ``model.*``, ``retry.*``,
+    ``numerics.*``, ``equiv.*``, …) must appear — backticked — in the
+    docs/static_analysis.md taxonomy.  An undocumented finding code is
+    a diagnostic an operator cannot look up.
+
 ``codec-bound``
     Any module defining a lossy codec (a module-level ``encode`` /
     ``decode`` function pair) must declare a machine-readable
@@ -323,6 +331,51 @@ def _check_fault_sites(root: str, rel: str, tree: ast.AST,
     return out
 
 
+# ---- rule: finding-code-doc -------------------------------------------
+
+#: a finding code literal: "<analysis>.<kebab-name>" for one of the
+#: known analysis families (anchored so prose never matches; must end
+#: on an alphanumeric so "model.hazard-"-style prefix literals used to
+#: build codes dynamically are out of scope)
+_FINDING_CODE_RE = re.compile(
+    r"^(typing|deadlock|liveness|structure|model|retry|numerics|equiv)"
+    r"\.[a-z][a-z0-9-]*[a-z0-9]$")
+
+
+def _static_analysis_text(root: str) -> str:
+    path = os.path.join(root, "docs", "static_analysis.md")
+    if not os.path.isfile(path):
+        return ""
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _check_finding_codes(rel: str, tree: ast.AST,
+                         sa_text: str) -> List[Violation]:
+    """Every finding-code string literal in an analysis module must be
+    documented (backticked) in docs/static_analysis.md — the taxonomy
+    tables are the operator's only decoder ring for verdict output."""
+    if not rel.startswith("alpa_tpu/analysis/"):
+        return []
+    out: List[Violation] = []
+    seen: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Constant)
+                and isinstance(node.value, str)):
+            continue
+        code = node.value
+        if code in seen or not _FINDING_CODE_RE.match(code):
+            continue
+        seen.add(code)
+        if f"`{code}`" not in sa_text:
+            out.append(Violation(
+                "finding-code-doc", rel, node.lineno,
+                f"finding code {code!r} is not documented in "
+                f"docs/static_analysis.md (add it to the analysis's "
+                f"taxonomy table)"))
+    return out
+
+
 # ---- rule: codec-bound ------------------------------------------------
 
 
@@ -368,6 +421,7 @@ def run_lint(root: Optional[str] = None) -> List[Violation]:
     root = root or repo_root()
     known = _known_sites()
     obs_text = _observability_text(root)
+    sa_text = _static_analysis_text(root)
     out: List[Violation] = list(_check_global_config(root))
     for path in _iter_py_files(root):
         tree = _parse(path)
@@ -379,6 +433,7 @@ def run_lint(root: Optional[str] = None) -> List[Violation]:
         out.extend(_check_metric_docs(rel, tree, obs_text))
         out.extend(_check_timer_imports(root, rel, tree))
         out.extend(_check_fault_sites(root, rel, tree, known))
+        out.extend(_check_finding_codes(rel, tree, sa_text))
         out.extend(_check_codec_bounds(rel, tree))
     out.sort(key=lambda v: (v.path, v.line, v.code))
     return out

@@ -905,26 +905,35 @@ def model_to_dict(model: PlanModel,
     """JSON-able form of a plan model + hooks + declared window — the
     committed model-check fixture format
     (``benchmark/results/model_check_fixture_plan.json``)."""
-    return {
+    out = {
         "format": "alpa-model-check-plan/v1",
         "mode": model.mode,
         "num_meshes": model.num_meshes,
         "overlap_window": overlap_window,
         "slots": [dataclasses.asdict(sm)
                   for _s, sm in sorted(model.slots.items())],
+        # the ISSUE-15 "equiv" facts are omitted when absent so
+        # pre-existing committed fixtures round-trip byte-identically
         "ops": [{k: (list(v) if isinstance(v, tuple) else v)
-                 for k, v in dataclasses.asdict(op).items()}
+                 for k, v in dataclasses.asdict(op).items()
+                 if not (k == "equiv" and v is None)}
                 for op in model.ops],
         "streams": [list(s) for s in model.streams],
         "deps": {str(i): sorted(v) for i, v in model.deps.items()},
         "hooks": [
-            {"kind": h.kind, "name": h.name, "node": h.node,
-             "mesh": h.mesh, "reads": list(h.reads),
-             "writes": list(h.writes), "kills": list(h.kills),
-             "slots": list(h.slots), "fault_site": h.fault_site,
-             "idempotent": h.idempotent, "members": list(h.members)}
+            dict({"kind": h.kind, "name": h.name, "node": h.node,
+                  "mesh": h.mesh, "reads": list(h.reads),
+                  "writes": list(h.writes), "kills": list(h.kills),
+                  "slots": list(h.slots), "fault_site": h.fault_site,
+                  "idempotent": h.idempotent,
+                  "members": list(h.members)},
+                 **({"equiv": h.equiv}
+                    if getattr(h, "equiv", None) is not None else {}))
             for h in (hooks or ())],
     }
+    if model.reference is not None:
+        out["reference"] = model.reference
+    return out
 
 
 def model_from_dict(d: Dict[str, Any]
@@ -960,14 +969,16 @@ def model_from_dict(d: Dict[str, Any]
         num_meshes=int(d.get("num_meshes", 1)),
         streams=[list(s) for s in d.get("streams", ())],
         deps={int(i): set(v) for i, v in d.get("deps", {}).items()},
-        mode=d.get("mode", "registers"))
+        mode=d.get("mode", "registers"),
+        reference=d.get("reference"))
     hooks = [OpHook(kind=h["kind"], name=h["name"], node=h["node"],
                     mesh=h["mesh"], reads=tuple(h["reads"]),
                     writes=tuple(h["writes"]), kills=tuple(h["kills"]),
                     slots=tuple(h.get("slots", ())),
                     fault_site=h.get("fault_site"),
                     idempotent=bool(h.get("idempotent", True)),
-                    members=tuple(h["members"]))
+                    members=tuple(h["members"]),
+                    equiv=h.get("equiv"))
              for h in d.get("hooks", ())]
     return model, hooks, int(d.get("overlap_window", 0))
 

@@ -4,7 +4,7 @@ register-file programs (ISSUE 8 tentpole).
 The pipeshard compiler's output is a *static* instruction program
 (RUN/RESHARD/FREE per mesh), which makes it exactly the artifact that
 can be verified before it ever touches hardware.  This module runs
-six analyses over the lowering's dataflow graph on EVERY
+seven analyses over the lowering's dataflow graph on EVERY
 ``lower_to_register_file`` compile (gated by
 ``global_config.verify_plans`` = ``"error" | "warn" | "off"``,
 default ``"warn"``):
@@ -51,6 +51,15 @@ default ``"warn"``):
    composed worst-case bound against ``numerics_error_budget``, flags
    below-fp32 accumulation, and enumerates which collectives are
    quantized vs full-precision.
+7. **Translation validation** (ISSUE 15,
+   :mod:`alpa_tpu.analysis.equivalence`, gated by
+   ``global_config.verify_plans_equiv``) — symbolic execution of the
+   lowered program over a hash-consed opaque term algebra, proving
+   every protected output's term graph equal to the reference term
+   obtained by serially composing the same stage decomposition over
+   the source jaxpr, modulo two documented rewrite axioms
+   (accumulation reassociation/commutation, resharding identity) —
+   the value-level check the first six analyses cannot make.
 
 The result is a :class:`PlanVerdict` (errors / warnings / stats),
 cached in the compile cache (namespace ``plan_verdict``, keyed by the
@@ -76,9 +85,9 @@ __all__ = [
     "verify_model", "verify_program", "verify_edge",
 ]
 
-#: the six analyses, in report order
+#: the seven analyses, in report order
 ANALYSES = ("typing", "deadlock", "liveness", "structure",
-            "model_check", "numerics")
+            "model_check", "numerics", "equiv")
 
 #: bump when an analysis changes meaning — invalidates cached verdicts
 #: (v2: launch-placed slots are accounted at per-device bytes derived
@@ -86,8 +95,11 @@ ANALYSES = ("typing", "deadlock", "liveness", "structure",
 #: the ~dp× reduction in ``peak_bytes``; v3: the ISSUE-13 model checker
 #: joins as the fifth analysis and verdicts grow a ``notes`` severity;
 #: v4: the ISSUE-14 numerics certification joins as the sixth analysis
-#: and slots/ops grow provenance/codec/precision facts)
-ANALYSES_VERSION = 4
+#: and slots/ops grow provenance/codec/precision facts; v5: the
+#: ISSUE-15 translation validation joins as the seventh analysis and
+#: RUN ops grow stage-decomposition ``equiv`` facts, so cached
+#: verdicts re-derive under the new proof obligations)
+ANALYSES_VERSION = 5
 
 _REG = _tmetrics.get_registry()
 _PEAK_BYTES = _REG.gauge(
@@ -182,6 +194,9 @@ class OpModel:
     codec: Optional[str] = None             # quantized RESHARD wire mode
     # RUN eqn-classification facts (eqn_classify; numerics analysis)
     precision: Optional[Dict[str, Any]] = None
+    # RUN stage-decomposition facts for the translation validation:
+    # {"stage": sig, "mb": int, "donate": [pos...], "acc": {out: in}}
+    equiv: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -199,6 +214,10 @@ class PlanModel:
     # (== send) order; the model checker's channel FIFO programs.
     channels: Dict[Tuple[int, int], List[int]] = \
         dataclasses.field(default_factory=dict)
+    # the driver's pre-lowering stage decomposition over (var,
+    # microbatch) value keys (alpa-equiv-reference/v1) — the
+    # translation validation's reference semantics
+    reference: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -304,6 +323,17 @@ class PlanVerdict:
                 + f"  max_error_bound="
                   f"{num.get('max_error_bound', 0.0):.6g}"
                   f"  budget={num.get('budget', 0.0):.6g}")
+        eq = st.get("equiv") if st else None
+        if eq:
+            lines.append(
+                "equiv: "
+                + (f"{eq.get('n_proved', 0)}/{eq.get('n_outputs', 0)} "
+                   f"outputs proved"
+                   if not eq.get("partial") else "PARTIAL")
+                + f"  terms={eq.get('n_terms', 0)}"
+                  f"  apps={eq.get('n_apps', 0)}"
+                  f"  axioms="
+                + (",".join(eq.get("axioms_used", ())) or "-"))
         for title, items in (("errors", self.errors),
                              ("warnings", self.warnings),
                              ("notes", self.notes)):
@@ -360,7 +390,8 @@ def build_model(instructions: Sequence[Any],
                 protected_keys=frozenset(),
                 mode: str = "registers",
                 opt_state_keys=frozenset(),
-                provenance_keys=None) -> PlanModel:
+                provenance_keys=None,
+                reference=None) -> PlanModel:
     """Assemble a :class:`PlanModel` from the lowering's inputs: the
     emitted instruction list, the slot table, the launch-placed keys,
     and the phase-1 per-instruction records (kind / footprint / edge /
@@ -412,6 +443,7 @@ def build_model(instructions: Sequence[Any],
             op.out_avals = tuple(
                 _aval_of(v)[:2] for v in getattr(ex, "outvars", ()))
             op.precision = r.get("precision")
+            op.equiv = r.get("equiv")
         elif kind == "RESHARD":
             op.edge = r.get("edge")
             op.cross = bool(r.get("cross", False))
@@ -434,7 +466,8 @@ def build_model(instructions: Sequence[Any],
                      mode=mode,
                      device_memory_bytes=_device_memory_bytes(),
                      channels={k: list(v)
-                               for k, v in st.channels.items()})
+                               for k, v in st.channels.items()},
+                     reference=reference)
 
 
 def _device_memory_bytes() -> Optional[float]:
@@ -882,7 +915,9 @@ def verify_model(model: PlanModel,
                  overlap_window: int = 0,
                  model_check_budget: Optional[int] = None,
                  numerics: bool = False,
-                 numerics_budget: Optional[float] = None
+                 numerics_budget: Optional[float] = None,
+                 equiv: bool = False,
+                 equiv_budget: Optional[int] = None
                  ) -> PlanVerdict:
     """Run the analyses over a plan model; pure function of its
     inputs (no metrics, no cache — see :func:`verify_program` for the
@@ -891,7 +926,11 @@ def verify_model(model: PlanModel,
     explores every stream interleaving, so the caller decides whether
     this plan is worth the state-space walk.  The sixth (the ISSUE-14
     numerics certification) is opt-in via ``numerics=True`` with a
-    per-tensor relative-error ``numerics_budget``."""
+    per-tensor relative-error ``numerics_budget``.  The seventh (the
+    ISSUE-15 translation validation) is opt-in via ``equiv=True`` with
+    a hash-consed term budget ``equiv_budget``; it proves the plan
+    against ``model.reference`` and consumes the numerics verdict to
+    decide whether the quantized-within-bound axiom is admissible."""
     t0 = time.perf_counter()
     findings: List[Finding] = []
     findings += check_typing(model)
@@ -913,6 +952,7 @@ def verify_model(model: PlanModel,
         mc_stats = mc.stats
 
     num_stats = None
+    numerics_ok: Optional[bool] = None
     num_severity: Dict[str, str] = {}
     if numerics:
         from alpa_tpu.analysis import numerics as _num
@@ -922,14 +962,27 @@ def verify_model(model: PlanModel,
         num_severity = {f.code: _num.severity_of(f.code)
                         for f in nr.findings}
         num_stats = nr.stats
+        numerics_ok = nr.ok
+
+    eq_stats = None
+    eq_severity: Dict[str, str] = {}
+    if equiv:
+        from alpa_tpu.analysis import equivalence as _eq
+        er = _eq.check_equiv(model, hooks=hooks, budget=equiv_budget,
+                             numerics_ok=numerics_ok)
+        findings += er.findings
+        eq_severity = {f.code: _eq.severity_of(f.code)
+                       for f in er.findings}
+        eq_stats = er.stats
 
     warning_codes = ("liveness.leak", "liveness.dead-store",
                      "liveness.peak-exceeds-memory",
                      "deadlock.channel-reorder")
     verdict = PlanVerdict()
     for f in findings:
-        sev = mc_severity.get(f.code) or num_severity.get(f.code) or (
-            "warning" if f.code in warning_codes else "error")
+        sev = mc_severity.get(f.code) or num_severity.get(f.code) or \
+            eq_severity.get(f.code) or (
+                "warning" if f.code in warning_codes else "error")
         {"error": verdict.errors, "warning": verdict.warnings,
          "note": verdict.notes}[sev].append(f)
     by_opcode: Dict[str, int] = {}
@@ -951,19 +1004,29 @@ def verify_model(model: PlanModel,
         verdict.stats["model_check"] = mc_stats
     if num_stats is not None:
         verdict.stats["numerics"] = num_stats
+    if eq_stats is not None:
+        verdict.stats["equiv"] = eq_stats
     return verdict
 
 
 def _cache_key(cache, fingerprint: str, mode: str,
                model_checked: bool = False,
                numerics: bool = False,
-               numerics_budget: Optional[float] = None) -> str:
+               numerics_budget: Optional[float] = None,
+               equiv: bool = False,
+               equiv_budget: Optional[int] = None,
+               ref_digest: str = "none") -> str:
     # the budget participates in findings (budget-exceeded), so it must
-    # key the cache alongside the on/off bit
+    # key the cache alongside the on/off bit; the reference digest must
+    # key it too — the program fingerprint only covers the lowering, so
+    # a changed source decomposition must re-derive the proof rather
+    # than replay a stale verdict
     num = f"num1b{numerics_budget!r}" if numerics else "num0"
+    eq = f"eq1b{equiv_budget!r}r{ref_digest}" if equiv else "eq0"
     return cache.make_key(
         "plan_verdict", [f"analyses_v{ANALYSES_VERSION}", mode,
-                         f"mc{int(model_checked)}", num, fingerprint])
+                         f"mc{int(model_checked)}", num, eq,
+                         fingerprint])
 
 
 def _model_check_enabled(n_ops: int) -> bool:
@@ -987,7 +1050,8 @@ def verify_program(instructions: Sequence[Any],
                    recs: Sequence[Dict[str, Any]],
                    protected_keys=frozenset(),
                    opt_state_keys=frozenset(),
-                   provenance_keys=None) -> PlanVerdict:
+                   provenance_keys=None,
+                   reference=None) -> PlanVerdict:
     """Compile-time entry point, called by ``lower_to_register_file``
     for every lowered program when ``global_config.verify_plans`` is
     not ``"off"``.
@@ -1002,17 +1066,26 @@ def verify_program(instructions: Sequence[Any],
     from alpa_tpu import compile_cache as _cc
     from alpa_tpu.global_env import global_config
 
+    from alpa_tpu.analysis import equivalence as _eq
+
     fingerprint = prog.fingerprint()
     do_mc = _model_check_enabled(len(instructions))
     do_num = getattr(global_config, "verify_plans_numerics",
                      "warn") != "off"
     num_budget = float(getattr(global_config, "numerics_error_budget",
                                0.05))
+    do_eq = getattr(global_config, "verify_plans_equiv",
+                    "warn") != "off" and reference is not None
+    eq_budget = int(getattr(global_config, "equiv_term_budget",
+                            _eq.DEFAULT_TERM_BUDGET))
     cache = _cc.get_compile_cache() if _cc.cache_enabled() else None
     verdict = None
     if cache is not None:
         key = _cache_key(cache, fingerprint, prog.mode, do_mc,
-                         numerics=do_num, numerics_budget=num_budget)
+                         numerics=do_num, numerics_budget=num_budget,
+                         equiv=do_eq, equiv_budget=eq_budget,
+                         ref_digest=_eq.reference_digest(
+                             reference if do_eq else None))
         hit = cache.get("plan_verdict", key)
         if isinstance(hit, dict) and \
                 hit.get("version") == ANALYSES_VERSION:
@@ -1023,13 +1096,15 @@ def verify_program(instructions: Sequence[Any],
                             protected_keys=protected_keys,
                             mode=prog.mode,
                             opt_state_keys=opt_state_keys,
-                            provenance_keys=provenance_keys)
+                            provenance_keys=provenance_keys,
+                            reference=reference if do_eq else None)
         verdict = verify_model(
             model, hooks=prog.hooks, model_check=do_mc,
             overlap_window=getattr(prog, "overlap_window", 0) or 0,
             model_check_budget=getattr(
                 global_config, "model_check_state_budget", None),
-            numerics=do_num, numerics_budget=num_budget)
+            numerics=do_num, numerics_budget=num_budget,
+            equiv=do_eq, equiv_budget=eq_budget)
         if cache is not None:
             cache.put("plan_verdict", key, verdict.to_dict())
 
@@ -1076,6 +1151,21 @@ def verify_program(instructions: Sequence[Any],
         from alpa_tpu.analysis import numerics as _num
         _num.export_metrics(num_stats)
 
+    # translation-validation metrics replay from the deterministic
+    # stats on cache hits too (same warm-restart contract)
+    eq_stats = verdict.stats.get("equiv")
+    if eq_stats is not None:
+        eq_codes = {f.code for f in verdict.findings()
+                    if f.analysis == "equiv"}
+        result = ("error" if any(_eq.severity_of(c) == "error"
+                                 for c in eq_codes)
+                  else "warning" if any(_eq.severity_of(c) == "warning"
+                                        for c in eq_codes)
+                  else "ok")
+        _eq.export_metrics(eq_stats, result)
+    else:
+        _eq.export_metrics(None, "skipped")
+
     _apply_policy(verdict, fingerprint)
     return verdict
 
@@ -1096,6 +1186,19 @@ def _apply_policy(verdict: PlanVerdict, fingerprint: str) -> None:
                 f"(plan {fingerprint[:12]}):\n"
                 + "\n".join(f"  [{f.code}] {f.message}"
                             for f in num_errors[:10]),
+                verdict)
+    # same independence for the translation validation: an output-level
+    # semantic mismatch blocks launch under verify_plans_equiv=error
+    # even when the general verifier is only warning
+    if getattr(global_config, "verify_plans_equiv", "warn") == "error":
+        eq_errors = [f for f in verdict.errors
+                     if f.analysis == "equiv"]
+        if eq_errors:
+            raise PlanVerificationError(
+                "translation validation failed "
+                f"(plan {fingerprint[:12]}):\n"
+                + "\n".join(f"  [{f.code}] {f.message}"
+                            for f in eq_errors[:10]),
                 verdict)
     if verdict.errors and policy == "error":
         raise PlanVerificationError(

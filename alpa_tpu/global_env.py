@@ -148,6 +148,23 @@ class GlobalConfig:
         # numerics.budget-exceeded finding.
         self.numerics_error_budget = float(os.environ.get(
             "ALPA_TPU_NUMERICS_ERROR_BUDGET", "0.05"))
+        # Seventh analysis (ISSUE 15): translation validation — prove
+        # every lowered plan computes the source jaxpr by symbolic
+        # execution over hash-consed opaque stage-application terms,
+        # modulo the documented rewrite axioms (accumulation
+        # reassociation/commutation, resharding identity).  "warn"
+        # (default) reports findings through the verify_plans policy;
+        # "error" blocks _launch with PlanVerificationError on any
+        # equiv.* error finding even when verify_plans itself only
+        # warns; "off" skips the analysis.
+        self.verify_plans_equiv = os.environ.get(
+            "ALPA_TPU_VERIFY_EQUIV", "warn")
+        # Hash-consed term budget for the translation validation.
+        # Exhaustion degrades to a partial verdict (an
+        # equiv.budget-exhausted note + the `partial` stat), never an
+        # error.
+        self.equiv_term_budget = int(os.environ.get(
+            "ALPA_TPU_EQUIV_TERM_BUDGET", "100000"))
         # Whether pipeshard runtime overlaps resharding with compute by
         # issuing transfers as soon as producers finish.  This is the
         # gate for the "overlap" dispatch mode under

@@ -189,6 +189,10 @@ def dump_debug_info(executable, dump_dir: str):
     # bounds, lossy-hop enumeration, budget verdicts
     if hasattr(executable, "get_numerics_text"):
         write("numerics.txt", executable.get_numerics_text())
+    # translation validation (ISSUE 15): per-output proof statuses,
+    # axioms used, term-diff witnesses on mismatch
+    if hasattr(executable, "get_equiv_text"):
+        write("equiv.txt", executable.get_equiv_text())
     # post-step perf analysis (ISSUE 9): critical path, bubbles, MFU
     if hasattr(executable, "get_perf_report_text"):
         write("perf_report.txt", executable.get_perf_report_text())

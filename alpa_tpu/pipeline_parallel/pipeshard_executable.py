@@ -969,13 +969,26 @@ class PipeshardDriverExecutable:
                 v, meshes = payload
                 for mb, m in meshes:
                     protected.add((v, mb, m))
+        # reference decomposition for the translation validation
+        # (ISSUE 15): the driver's pre-lowering RUN stream as serial
+        # stage applications over (var, microbatch) value keys —
+        # deliberately derived here, before lowering, so the certifier
+        # proves the register program against an independent artifact
+        equiv_reference = None
+        if getattr(global_config, "verify_plans", "warn") != "off" and \
+                getattr(global_config, "verify_plans_equiv",
+                        "warn") != "off":
+            from alpa_tpu.analysis import equivalence as _equiv
+            equiv_reference = _equiv.build_reference(
+                self.instructions, n_mb)
         prog = lower_to_register_file(self.instructions, preplaced,
                                       mode=mode,
                                       overlap_window=self._overlap_window(),
                                       protected_keys=frozenset(protected),
                                       opt_state_keys=frozenset(
                                           opt_state_keys),
-                                      provenance_keys=provenance_keys)
+                                      provenance_keys=provenance_keys,
+                                      equiv_reference=equiv_reference)
         self._register_programs[mode] = prog
         if mode == "registers":
             self._register_program = prog
@@ -1424,6 +1437,26 @@ class PipeshardDriverExecutable:
         num_findings = [f for f in verdict.findings()
                         if f.analysis == "numerics"]
         return _num.format_numerics(num_stats, num_findings)
+
+    def get_equiv_text(self) -> str:
+        """``equiv.txt`` content for dump_debug_info (ISSUE 15): the
+        translation validation's per-output proof table + findings for
+        the lowered plan."""
+        verdict = None
+        try:
+            verdict = self.get_plan_verdict()
+        except Exception:  # pylint: disable=broad-except
+            logger.exception("get_equiv_text failed")
+        if verdict is None:
+            return ("equiv: (not available — verify_plans=off, "
+                    "lowering failed, or launch not register-eligible)")
+        eq_stats = verdict.stats.get("equiv")
+        if not eq_stats:
+            return "equiv: (not run — verify_plans_equiv=off)"
+        from alpa_tpu.analysis import equivalence as _eq
+        eq_findings = [f for f in verdict.findings()
+                       if f.analysis == "equiv"]
+        return _eq.format_equiv(eq_stats, eq_findings)
 
     def get_perf_report(self):
         """Post-step :class:`~alpa_tpu.telemetry.perf.StepPerfReport`

@@ -617,6 +617,9 @@ class OpHook:
     # RUN eqn-classification facts (ISSUE 14 numerics certification):
     # matmul/reduce/cast counts + narrowest accumulation dtype
     precision: Optional[Any] = None
+    # RUN stage-decomposition facts (ISSUE 15 translation validation):
+    # {"stage": sig, "mb": int, "donate": [pos...], "acc": {out: in}}
+    equiv: Optional[Any] = None
     # flat instruction indices this op replays: (idx,) for singletons,
     # every folded member for batched groups — the plan verifier
     # (ISSUE 8) checks the footprint above equals the union of the
@@ -1167,6 +1170,7 @@ def lower_to_register_file(
         protected_keys=frozenset(),
         opt_state_keys=frozenset(),
         provenance_keys=None,
+        equiv_reference=None,
 ) -> RegisterFileProgram:
     """Lower the emitted instruction list into a :class:`RegisterFileProgram`.
 
@@ -1238,6 +1242,26 @@ def lower_to_register_file(
             _prec_cache[key] = classify_stage_precision(ex)
         return _prec_cache[key]
 
+    # translation validation (ISSUE 15): the per-RUN stage signature /
+    # donation / accumulation facts the symbolic executor applies —
+    # derived by the same shared helper the driver's reference
+    # decomposition uses, so a correct lowering matches by construction
+    want_equiv = (
+        getattr(global_config, "verify_plans", "warn") != "off" and
+        getattr(global_config, "verify_plans_equiv", "warn") != "off"
+        and equiv_reference is not None)
+
+    def _equiv_of(inst, ex):
+        if not want_equiv:
+            return None
+        from alpa_tpu.analysis.equivalence import stage_equiv_info
+        info = stage_equiv_info(ex)
+        mb = getattr(inst, "micro_batch", None)
+        return {"stage": info["stage"],
+                "mb": int(mb) if mb is not None else -1,
+                "donate": list(info["donate"]),
+                "acc": dict(info["acc"])}
+
     for inst in instructions:
         if inst.opcode == PipelineInstType.RUN:
             by_opcode["RUN"] += 1
@@ -1273,6 +1297,7 @@ def lower_to_register_file(
                 "site": "stage_launch",
                 "finfo": {"stage": inst.info, "mesh_id": inst.dst_mesh},
                 "precision": _precision_of(ex),
+                "equiv": _equiv_of(inst, ex),
                 "idem": not donated,
                 "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "
@@ -1367,6 +1392,7 @@ def lower_to_register_file(
                       fault_infos=(r["finfo"],) if site else (),
                       idempotent=r.get("idem", True),
                       precision=r.get("precision"),
+                      equiv=r.get("equiv"),
                       members=(idx,))
 
     def _group_hook(mem_idx, kind="exec", label=None):
@@ -1594,7 +1620,8 @@ def lower_to_register_file(
             instructions, prog, preplaced_shardings, recs,
             protected_keys=protected_keys,
             opt_state_keys=opt_state_keys,
-            provenance_keys=provenance_keys)
+            provenance_keys=provenance_keys,
+            reference=equiv_reference)
     return prog
 
 

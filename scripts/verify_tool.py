@@ -10,6 +10,8 @@ Usage::
                                              [--budget N] [--json]
     python scripts/verify_tool.py numerics [--fixture PATH]
                                            [--error-budget F] [--json]
+    python scripts/verify_tool.py equiv [--fixture PATH]
+                                        [--term-budget N] [--json]
 
 ``verify plan`` prints the cached :class:`PlanVerdict` of every lowered
 register-file program found in the compile cache's disk tier — WITHOUT
@@ -78,6 +80,31 @@ error-severity finding.  ``--json`` emits the **stable** schema
                                 "storage", "accum", "bound",
                                 "hops"}...],   # program outputs
                "budget": 0.05, "n_tracked": N, "seconds": 0.001}}
+
+Fields are only ever added, never renamed or removed, within /v1.
+
+``equiv`` runs the translation validation (ISSUE 15,
+``alpa_tpu.analysis.equivalence``) standalone on a serialized plan
+fixture (same ``alpa-model-check-plan/v1`` serialization, which must
+embed a ``reference`` program; default: the committed 2-mesh
+4-microbatch fixture under ``benchmark/results/``) and prints the
+per-output proof table, axioms used, and every ``equiv.*`` finding
+with its term-diff witness.  Exit status 1 on any error-severity
+finding.  ``--json`` emits the **stable** schema ``alpa-equiv/v1``::
+
+    {"schema": "alpa-equiv/v1",
+     "fixture": "<path>",
+     "ok": true,                       # no error-severity findings
+     "findings": [{"analysis", "code", "message", "op",
+                   "severity"}...],
+     "stats": {"n_terms": N, "n_outputs": N, "n_proved": N,
+               "n_apps": N, "num_microbatches": N,
+               "axioms_used": ["accumulation-reassociation", ...],
+               "per_output": [{"var", "instance", "mesh", "slot",
+                               "axioms", "status",
+                               "witness"?}...],  # protected outputs
+               "budget": 100000, "partial": false,
+               "seconds": 0.001}}
 
 Fields are only ever added, never renamed or removed, within /v1.
 
@@ -221,6 +248,8 @@ DEFAULT_FIXTURE = os.path.join(
     REPO, "benchmark", "results", "model_check_fixture_plan.json")
 DEFAULT_NUMERICS_FIXTURE = os.path.join(
     REPO, "benchmark", "results", "numerics_fixture_plan.json")
+DEFAULT_EQUIV_FIXTURE = os.path.join(
+    REPO, "benchmark", "results", "equiv_fixture_plan.json")
 
 
 def cmd_modelcheck(args):
@@ -265,6 +294,35 @@ def cmd_numerics(args):
              "ok": result.ok,
              "findings": [dict(f.to_dict(),
                                severity=num.severity_of(f.code))
+                          for f in result.findings],
+             "stats": result.stats},
+            indent=2, sort_keys=True, default=str))
+    else:
+        print(f"fixture: {args.fixture}")
+        print(result.format())
+    if not result.ok:
+        sys.exit(1)
+
+
+def cmd_equiv(args):
+    from alpa_tpu.analysis import equivalence as eq
+    from alpa_tpu.analysis import model_check as mc
+    try:
+        model, hooks, _window = mc.load_fixture(args.fixture)
+    except (OSError, ValueError, KeyError) as e:
+        sys.exit(f"cannot load plan fixture {args.fixture}: {e}")
+    if model.reference is None:
+        sys.exit(f"fixture {args.fixture} embeds no reference program; "
+                 f"translation validation needs one (serialize the "
+                 f"model with build_model(..., reference=...))")
+    result = eq.check_equiv(model, hooks=hooks, budget=args.term_budget)
+    if args.json:
+        print(json.dumps(
+            {"schema": "alpa-equiv/v1",
+             "fixture": args.fixture,
+             "ok": result.ok,
+             "findings": [dict(f.to_dict(),
+                               severity=eq.severity_of(f.code))
                           for f in result.findings],
              "stats": result.stats},
             indent=2, sort_keys=True, default=str))
@@ -340,6 +398,19 @@ def main():
                         "numerics.DEFAULT_ERROR_BUDGET)")
     u.add_argument("--json", action="store_true")
     u.set_defaults(fn=cmd_numerics)
+    e = sub.add_parser(
+        "equiv",
+        help="run the translation validation on a serialized plan "
+             "fixture (alpa-model-check-plan/v1 with an embedded "
+             "reference program) standalone")
+    e.add_argument("--fixture", default=DEFAULT_EQUIV_FIXTURE,
+                   help="fixture JSON path (default: the committed "
+                        "2-mesh 4-microbatch fixture)")
+    e.add_argument("--term-budget", type=int, default=None,
+                   help="hash-consed term budget (default: "
+                        "equivalence.DEFAULT_TERM_BUDGET)")
+    e.add_argument("--json", action="store_true")
+    e.set_defaults(fn=cmd_equiv)
     args = parser.parse_args()
     args.fn(args)
 

@@ -33,10 +33,13 @@ def test_lint_rules_actually_detect(tmp_path):
         "\n"
         "def decode(q, s, shape, dtype, mode):\n"
         "    return q\n")
+    (pkg / "analysis").mkdir()
+    (pkg / "analysis" / "badfinding.py").write_text(
+        "CODE = 'equiv.scratch-undocumented'\n")
     codes = {v.code for v in lint.run_lint(root=str(tmp_path))}
     assert codes >= {"config-env", "config-doc", "metric-name",
                      "metric-doc", "timer-import", "fault-site",
-                     "codec-bound"}, codes
+                     "codec-bound", "finding-code-doc"}, codes
 
 
 def test_known_sites_registry_matches_docstring_table():
