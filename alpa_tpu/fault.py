@@ -11,19 +11,32 @@ substrate for that, used across the stack:
    calls ``fault.fire("<site>", **info)`` at instrumented points; with
    no active plan this is a near-zero-cost no-op.  Instrumented sites:
 
-   ===================  ====================================================
-   site                 where
-   ===================  ====================================================
-   ``probe``            ``monitoring.check_alive``'s device probe
-   ``stage_launch``     pipeshard RUN instruction dispatch
-   ``cross_mesh_send``  pipeshard RESHARD instruction dispatch
-   ``cross_mesh_recv``  ``ReshardingTask.run`` / ``run_multiprocess`` entry
-   ``scheduler_take``   ``serve.controller.RequestBatcher`` batch formation
-   ``scheduler_tick``   ``serve.engine.ContinuousBatchingEngine`` decode tick
-   ``distributed_init`` ``distributed.initialize`` bring-up
-   ===================  ====================================================
+   =====================  ==================================================
+   site                   where
+   =====================  ==================================================
+   ``probe``              ``monitoring.check_alive``'s device probe
+   ``stage_launch``       pipeshard RUN instruction dispatch
+   ``cross_mesh_send``    pipeshard RESHARD instruction dispatch
+   ``cross_mesh_recv``    ``ReshardingTask.run`` / ``run_multiprocess`` entry
+   ``scheduler_take``     ``serve.controller.RequestBatcher`` batch formation
+   ``scheduler_tick``     ``serve.engine.ContinuousBatchingEngine`` decode
+                          tick
+   ``distributed_init``   ``distributed.initialize`` bring-up
+   ``worker_lost``        ``elastic.ElasticSupervisor`` step-boundary poll
+                          (a mesh's workers died; re-solve for survivors)
+   ``preemption_notice``  ``elastic.ElasticSupervisor`` step-boundary poll
+                          (eviction warning; snapshot inside the grace
+                          window before the kill lands)
+   ``wedge_detected``     ``elastic.WedgeDetector.check`` probe sweep (a
+                          device answers nothing — not even an error)
+   =====================  ==================================================
 
    Recovery re-probes fire at sites ``probe`` and ``recovery_probe``.
+   The three elastic sites (``ELASTIC_SITES``) additionally escalate:
+   retry exhaustion there routes into the installed
+   ``RecoveryManager`` (``set_escalation_manager``) instead of
+   propagating a raw ``RetryExhaustedError`` — worker loss is a
+   lifecycle event to recover from, not an RPC error to re-raise.
 
 2. **Retry policy** (``RetryPolicy`` + ``call_with_retry``): jittered
    exponential backoff with deadline budgets and per-site overrides,
@@ -67,7 +80,8 @@ _STATE_TRANSITIONS = _tmetrics.get_registry().counter(
 
 __all__ = [
     "FaultSpec", "FaultPlan", "InjectedFault", "fire", "active_plan",
-    "KNOWN_SITES",
+    "KNOWN_SITES", "ELASTIC_SITES",
+    "set_escalation_manager", "get_escalation_manager",
     "RetryPolicy", "RetryExhaustedError", "call_with_retry",
     "set_retry_policy", "get_retry_policy", "retry_stats",
     "install_retry_classification", "get_retry_classification",
@@ -85,6 +99,16 @@ KNOWN_SITES = frozenset({
     "probe", "stage_launch", "cross_mesh_send", "cross_mesh_recv",
     "scheduler_take", "scheduler_tick", "distributed_init",
     "recovery_probe",
+    "worker_lost", "preemption_notice", "wedge_detected",
+})
+
+#: Elastic-lifecycle sites (ISSUE 16): failures here are cluster
+#: membership events, not transient RPC errors.  ``call_with_retry``
+#: exhaustion at these sites escalates into the installed
+#: RecoveryManager (``set_escalation_manager``) rather than propagating
+#: a raw ``RetryExhaustedError`` to the caller.
+ELASTIC_SITES = frozenset({
+    "worker_lost", "preemption_notice", "wedge_detected",
 })
 
 
@@ -366,6 +390,45 @@ def get_retry_classification() -> Dict[str, Dict[str, Any]]:
         return {s: dict(e) for s, e in _RETRY_CLASSIFICATION.items()}
 
 
+#: Process-global escalation target for ELASTIC_SITES retry exhaustion:
+#: a RecoveryManager (or anything with ``escalate(site, error)``).
+_ESCALATION_MANAGER: Optional[Any] = None
+
+
+def set_escalation_manager(manager: Optional[Any]) -> Optional[Any]:
+    """Install (``None`` clears) the RecoveryManager that absorbs retry
+    exhaustion at ``ELASTIC_SITES``.  Returns the previous target so
+    tests and nested supervisors can restore it."""
+    global _ESCALATION_MANAGER
+    with _POLICY_LOCK:
+        prev = _ESCALATION_MANAGER
+        _ESCALATION_MANAGER = manager
+    return prev
+
+
+def get_escalation_manager() -> Optional[Any]:
+    with _POLICY_LOCK:
+        return _ESCALATION_MANAGER
+
+
+def _escalate_exhaustion(site: str, attempts: int,
+                         error: BaseException) -> bool:
+    """Route elastic-site retry exhaustion into the recovery state
+    machine.  True when a manager absorbed it (the caller then raises
+    ``ServiceDegradedError`` instead of the raw error)."""
+    if site not in ELASTIC_SITES:
+        return False
+    manager = get_escalation_manager()
+    if manager is None:
+        return False
+    try:
+        manager.escalate(site, error)
+        return True
+    except Exception:  # pylint: disable=broad-except
+        logger.exception("elastic escalation of %s failed", site)
+        return False
+
+
 def _refuse_statically_unsafe(site: str) -> bool:
     """True when the model checker proved retrying ``site`` unsafe for
     the verified plan AND the operator runs with verify_plans=error —
@@ -459,6 +522,15 @@ def call_with_retry(fn: Callable[[], Any],
                 time.monotonic() - start >= pol.deadline)
             if not retryable or out_of_attempts or out_of_budget:
                 _account_retries(site, attempts - 1, delays)
+                if _escalate_exhaustion(site, attempts, e):
+                    # elastic lifecycle event: the recovery manager now
+                    # owns it (quiesce/snapshot/degrade); callers see a
+                    # typed degradation signal, never the raw
+                    # RetryExhaustedError / transport error
+                    raise ServiceDegradedError(
+                        f"{site}: {attempts} attempt(s) failed; "
+                        "escalated to the recovery manager "
+                        f"(last error: {type(e).__name__}: {e})") from e
                 raise
             delay = pol.backoff(attempts, rng)
             if pol.deadline is not None:
@@ -698,6 +770,26 @@ class RecoveryManager:
         if was_degraded:
             logger.warning("mesh group recovered from DEGRADED (%s)",
                            reason)
+
+    def escalate(self, site: str, error: BaseException) -> MeshHealth:
+        """Absorb an elastic-site retry exhaustion (``worker_lost`` /
+        ``preemption_notice`` / ``wedge_detected``; see
+        ``set_escalation_manager``): the failure is treated as a failed
+        watchdog round — SUSPECT, then the quiesce → snapshot →
+        re-probe recovery path — instead of propagating to the caller.
+        """
+        logger.warning("elastic site %s exhausted retries (%s: %s); "
+                       "escalating into recovery", site,
+                       type(error).__name__, error)
+        state = self.state
+        if state is MeshHealth.HEALTHY:
+            self._transition(MeshHealth.SUSPECT,
+                             f"elastic escalation from {site}")
+            self._begin_recovery()
+        elif state is MeshHealth.SUSPECT:
+            self._begin_recovery()
+        # RECOVERING / DEGRADED: recovery already owns the failure
+        return self.state
 
     def tick(self) -> MeshHealth:
         """Probe every mesh once and feed the result to the state

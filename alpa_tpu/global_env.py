@@ -253,6 +253,41 @@ class GlobalConfig:
         self.calibration_dir = os.environ.get(
             "ALPA_TPU_CALIBRATION_DIR", None)
 
+        # ---------- elastic training (ISSUE 16) ----------
+        # ElasticSupervisor budgets (alpa_tpu/elastic.py; see
+        # docs/fault_tolerance.md#elastic-training).  Step budget: max
+        # committed steps an episode may lose (checkpoint cadence must
+        # keep the replay distance under this); exceeding it is recorded
+        # in alpa_elastic_budget_violations_total, it never blocks the
+        # resume itself.
+        self.elastic_step_budget = int(os.environ.get(
+            "ALPA_TPU_ELASTIC_STEP_BUDGET", "4"))
+        # Wall-clock budget (seconds) for one detect -> resume episode.
+        self.elastic_time_budget_s = float(os.environ.get(
+            "ALPA_TPU_ELASTIC_TIME_BUDGET", "300"))
+        # Preemption grace window (seconds): on a preemption *notice*
+        # the supervisor snapshots synchronously and must land the write
+        # inside this window for the snapshot to count as before-kill.
+        self.elastic_grace_period_s = float(os.environ.get(
+            "ALPA_TPU_ELASTIC_GRACE", "30"))
+        # How long quiesce() may wait for in-flight pipeshard launches
+        # to drain before the episode proceeds with a torn step (the
+        # restore path makes that safe — resume replays from the last
+        # verified checkpoint either way).
+        self.elastic_quiesce_timeout_s = float(os.environ.get(
+            "ALPA_TPU_ELASTIC_QUIESCE_TIMEOUT", "60"))
+        # Checkpoint every N successful steps while supervised (1 =
+        # every step; the replay distance after a failure is at most
+        # this interval, so keep it <= elastic_step_budget).
+        self.elastic_snapshot_interval = int(os.environ.get(
+            "ALPA_TPU_ELASTIC_SNAPSHOT_INTERVAL", "1"))
+        # WedgeDetector probe timeout (seconds) — the runbook's
+        # ``timeout 120`` leg discipline (scripts/chip_recovery_runbook
+        # .sh): a probe that neither answers nor errors inside this
+        # window classifies the device as wedged, not dead.
+        self.wedge_probe_timeout_s = float(os.environ.get(
+            "ALPA_TPU_WEDGE_PROBE_TIMEOUT", "120"))
+
         # ---------- compile cache ----------
         # On-disk tier of the persistent compile cache (ILP auto-sharding
         # solutions, stage-DP decisions, parallel_plan artifacts — see

@@ -660,7 +660,10 @@ class _Handler(BaseHTTPRequestHandler):
         ``last_flight_dump`` — the path of the most recent flight
         recorder post-mortem (ISSUE 6), so an operator seeing a SUSPECT
         or DEGRADED state knows where the instruction timeline landed
-        (null when nothing has been dumped)."""
+        (null when nothing has been dumped), and ``elastic`` — the
+        ElasticSupervisor's episode report when this process runs one
+        (docs/fault_tolerance.md#elastic-training; null otherwise)."""
+        from alpa_tpu import elastic as _elastic
         from alpa_tpu.telemetry import flight as _flight
         recovery = self.controller._recovery
         if recovery is not None:
@@ -668,10 +671,12 @@ class _Handler(BaseHTTPRequestHandler):
             code = 503 if state == "degraded" else 200
             self._send(code, {"status": state,
                               "last_flight_dump": _flight.last_dump_path(),
+                              "elastic": _elastic.status_report(),
                               "load": self.controller.load_report()})
             return
         report = self.controller.health_report()
         report["last_flight_dump"] = _flight.last_dump_path()
+        report["elastic"] = _elastic.status_report()
         report["load"] = self.controller.load_report()
         code = 503 if report["status"] == "shedding" else 200
         self._send(code, report)

@@ -8,6 +8,14 @@
 # configs pre-validated against the HBM estimator (the relay wedges on
 # near-OOM programs and stays wedged for hours).
 #
+# This probe-between-legs discipline is codified in
+# alpa_tpu/elastic.py (WedgeDetector: ok / wedged / dead, stop at the
+# first wedge); training runs recover automatically through the
+# ElasticSupervisor.  When recovering a run by hand, restore the step
+#   python scripts/ckpt_tool.py last-good "$CKPT_ROOT"
+# prints — the same hash-verified step the supervisor rolls back to
+# (docs/fault_tolerance.md#elastic-training).
+#
 #   bash scripts/chip_recovery_runbook.sh [results_file]
 #
 # Legs (in order):

@@ -7,6 +7,7 @@ Usage::
     python scripts/ckpt_tool.py verify  ROOT [--step N | --all]
     python scripts/ckpt_tool.py gc      ROOT [--keep-last-k K]
                                              [--keep-every-n N]
+    python scripts/ckpt_tool.py last-good ROOT
     python scripts/ckpt_tool.py stat
 
 ``inspect`` lists committed steps; with ``--step`` it prints one
@@ -18,8 +19,13 @@ layouts while params stay ``full``;
 ``verify`` re-hashes every chunk a step references and exits non-zero
 on corruption; ``gc`` optionally applies a retention policy, then
 deletes chunks no surviving manifest references (do NOT run it while a
-training run is saving into the same root); ``stat`` prints the
-process-global checkpoint counters.
+training run is saving into the same root); ``last-good`` prints the
+most recent *verified* step — newest manifest whose every chunk passes
+hash verification, the exact step the elastic supervisor restores
+(docs/fault_tolerance.md#elastic-training) — and exits non-zero when
+no step verifies, so shell runbooks and the supervisor share one
+source of truth; ``stat`` prints the process-global checkpoint
+counters.
 """
 import argparse
 import os
@@ -156,6 +162,14 @@ def cmd_gc(args):
           f"freed {_fmt_bytes(result['bytes_freed'])}")
 
 
+def cmd_last_good(args):
+    store = _store(args)
+    step = store.last_verified_step()
+    if step is None:
+        sys.exit(f"no verified steps in {args.root}")
+    print(step)
+
+
 def cmd_stat(args):
     from alpa_tpu.monitoring import format_checkpoint_report
     print(format_checkpoint_report())
@@ -181,6 +195,11 @@ def main():
     p.add_argument("--keep-last-k", type=int, default=0)
     p.add_argument("--keep-every-n", type=int, default=0)
     p.set_defaults(fn=cmd_gc)
+
+    p = sub.add_parser("last-good",
+                       help="print the newest hash-verified step")
+    p.add_argument("root")
+    p.set_defaults(fn=cmd_last_good)
 
     p = sub.add_parser("stat", help="process-global counters")
     p.set_defaults(fn=cmd_stat)
