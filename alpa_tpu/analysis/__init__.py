@@ -30,9 +30,15 @@ Two halves:
   provenance, lossy-hop lists) from the transfer codec's documented
   ``ERROR_BOUND`` contract.  Runs as the sixth ``verify_program``
   analysis behind ``global_config.verify_plans_numerics``.
+* :mod:`alpa_tpu.analysis.superopt` — a certified post-lowering
+  rewrite engine (ISSUE 17): re-scheduling, FREE motion, transfer
+  fusion/fission, and recompute flips over the lowered instruction
+  list, scored by ``simulate_dag`` over calibrated costs and accepted
+  only when the seven-analysis verdict introduces no new finding vs
+  the baseline.  Behind ``global_config.superopt_mode``.
 """
 from alpa_tpu.analysis.critical_path import (  # noqa: F401
-    CriticalPathReport, PathStep, TimedOp, longest_path,
+    CriticalPathReport, MemSpec, PathStep, TimedOp, longest_path,
     measured_critical_path, simulate_dag)
 from alpa_tpu.analysis.model_check import (  # noqa: F401
     ModelCheckResult, check_model, load_fixture, model_from_dict,
@@ -42,3 +48,6 @@ from alpa_tpu.analysis.numerics import (  # noqa: F401
 from alpa_tpu.analysis.plan_verifier import (  # noqa: F401
     Finding, PlanModel, PlanVerdict, PlanVerificationError,
     verify_model)
+from alpa_tpu.analysis.superopt import (  # noqa: F401
+    PlanScore, SuperoptOutcome, reshard_group_extent, run_superopt,
+    superopt_search, verdict_diff, verdict_new_findings)

@@ -28,7 +28,7 @@ import dataclasses
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 __all__ = [
-    "TimedOp", "PathStep", "CriticalPathReport",
+    "TimedOp", "PathStep", "CriticalPathReport", "MemSpec",
     "measured_critical_path", "simulate_dag", "longest_path", "whatif",
 ]
 
@@ -191,13 +191,73 @@ def measured_critical_path(
     return _finalize(steps, envelope_us)
 
 
+@dataclasses.dataclass(frozen=True)
+class MemSpec:
+    """Per-op memory effects for the DAG re-simulation (ISSUE 17): the
+    slot-level footprint the plan verifier's liveness pass walks
+    statically, here replayed on the *simulated* timeline so schedule
+    rewrites can be scored on peak-live-bytes before they are lowered.
+
+    ``writes[i]`` / ``kills[i]`` are the slot ids op ``i`` defines /
+    frees; ``nbytes``/``mesh_of`` map slot id -> size / owning mesh;
+    ``preplaced`` slots are live from t=0 (launch placement).  The state
+    machine matches ``plan_verifier.check_liveness`` exactly — a write
+    allocates only when the slot is not already live, a kill releases
+    only a live slot — so a serial replay in program order reproduces
+    the static ``alpa_plan_peak_bytes`` figure bit for bit."""
+    writes: Sequence[Sequence[int]]
+    kills: Sequence[Sequence[int]]
+    nbytes: Dict[int, float]
+    mesh_of: Dict[int, int]
+    num_meshes: int = 1
+    preplaced: frozenset = frozenset()
+
+
+def _simulate_peaks(finish: Sequence[float],
+                    mem: MemSpec) -> List[float]:
+    """Peak live bytes per mesh over the simulated timeline: each op's
+    memory effects (writes then kills, mirroring the static walk's
+    per-op order) land at its simulated finish time; ties resolve in op
+    order so a serial chain replays program order."""
+    n_meshes = max(1, mem.num_meshes)
+
+    def _mesh(s):
+        m = mem.mesh_of.get(s, 0)
+        return m if 0 <= m < n_meshes else 0
+
+    live_bytes = [0.0] * n_meshes
+    _UNDEF, _LIVE, _DEAD = 0, 1, 2
+    state: Dict[int, int] = {}
+    for s in mem.preplaced:
+        state[s] = _LIVE
+        live_bytes[_mesh(s)] += mem.nbytes.get(s, 0)
+    peaks = list(live_bytes)
+    order = sorted(range(len(finish)), key=lambda i: (finish[i], i))
+    for i in order:
+        for s in mem.kills[i]:
+            if state.get(s, _UNDEF) == _LIVE:
+                live_bytes[_mesh(s)] -= mem.nbytes.get(s, 0)
+            state[s] = _DEAD
+        for s in mem.writes[i]:
+            if state.get(s, _UNDEF) != _LIVE:
+                m = _mesh(s)
+                live_bytes[m] += mem.nbytes.get(s, 0)
+                if live_bytes[m] > peaks[m]:
+                    peaks[m] = live_bytes[m]
+            state[s] = _LIVE
+    return peaks
+
+
 def simulate_dag(durs_us: Sequence[float],
-                 preds: Sequence[Iterable[int]]
-                 ) -> Tuple[float, List[float]]:
+                 preds: Sequence[Iterable[int]],
+                 mem: Optional[MemSpec] = None):
     """Earliest-finish replay of the dependency DAG (causal edges only,
     idealized parallel driver).  ``preds[i]`` must reference earlier
     indices; later/self references are ignored.  Returns
-    ``(makespan_us, finish_us)``."""
+    ``(makespan_us, finish_us)`` — or, with a :class:`MemSpec`,
+    ``(makespan_us, finish_us, peak_bytes_per_mesh)`` tracking the
+    simulated peak-live-bytes each mesh reaches (ISSUE 17's FREE-motion
+    objective)."""
     n = len(durs_us)
     finish = [0.0] * n
     for i in range(n):
@@ -206,7 +266,10 @@ def simulate_dag(durs_us: Sequence[float],
             if 0 <= p < i and finish[p] > start:
                 start = finish[p]
         finish[i] = start + durs_us[i]
-    return (max(finish) if finish else 0.0), finish
+    makespan = max(finish) if finish else 0.0
+    if mem is None:
+        return makespan, finish
+    return makespan, finish, _simulate_peaks(finish, mem)
 
 
 def longest_path(durs_us: Sequence[float],
@@ -237,10 +300,17 @@ def longest_path(durs_us: Sequence[float],
 
 def whatif(durs_us: Sequence[float],
            preds: Sequence[Iterable[int]],
-           zeroed: Set[int]) -> float:
+           zeroed: Set[int],
+           mem: Optional[MemSpec] = None):
     """Makespan with the chosen ops made free — the "if this RESHARD
     cost nothing" re-simulation.  Monotone: never exceeds the baseline
-    :func:`simulate_dag` makespan."""
+    :func:`simulate_dag` makespan.  With a :class:`MemSpec`, returns
+    ``(makespan_us, peak_bytes_per_mesh)`` so memory-motion what-ifs
+    ("if this FREE ran right after the last use") are scored on the
+    same timeline."""
     durs = [0.0 if i in zeroed else d for i, d in enumerate(durs_us)]
-    makespan, _ = simulate_dag(durs, preds)
-    return makespan
+    if mem is None:
+        makespan, _ = simulate_dag(durs, preds)
+        return makespan
+    makespan, _, peaks = simulate_dag(durs, preds, mem)
+    return makespan, peaks

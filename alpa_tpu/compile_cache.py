@@ -14,6 +14,10 @@ phases write through:
   clustering + submesh shapes + per-stage autosharding dicts).
 * ``parallel_plan`` namespace — replayable ``ParallelPlan`` artifacts
   saved by ``api.parallelize`` after each compile.
+* ``superopt`` namespace — accepted certified-superoptimization rewrite
+  layouts (``analysis/superopt.py``), keyed by baseline program
+  fingerprint + calibration-store fingerprint + search knobs, so warm
+  restarts replay the winning rewrite with zero search.
 
 Keying: sha256 over a canonical fingerprint of every input that shapes the
 answer, ALWAYS including ``jax.__version__`` and a format version — a jax

@@ -253,6 +253,35 @@ class GlobalConfig:
         self.calibration_dir = os.environ.get(
             "ALPA_TPU_CALIBRATION_DIR", None)
 
+        # ---------- certified plan superoptimization (ISSUE 17) ------
+        # Post-lowering rewrite engine over RegisterFileProgram
+        # (analysis/superopt.py): instruction re-scheduling, FREE
+        # sinking/hoisting, transfer fusion/fission, recompute flips —
+        # scored by simulate_dag over calibrated costs and accepted
+        # only when the seven-analysis verdict introduces no new
+        # (analysis, code) finding vs the baseline.  "off" skips the
+        # engine entirely (byte-identical plans); "suggest" searches
+        # and reports (superopt.txt, alpa_superopt_* metrics) without
+        # applying; "auto" swaps the accepted rewritten program in.
+        self.superopt_mode = os.environ.get(
+            "ALPA_TPU_SUPEROPT_MODE", "off")
+        # Beam width of the greedy rewrite search.
+        self.superopt_beam_width = int(os.environ.get(
+            "ALPA_TPU_SUPEROPT_BEAM", "4"))
+        # Rewrite-step budget: total candidates the search may score.
+        self.superopt_step_budget = int(os.environ.get(
+            "ALPA_TPU_SUPEROPT_STEPS", "32"))
+        # Max candidate lowerings the verdict gate may run per compile
+        # (each gate check re-lowers + re-verifies one candidate).
+        self.superopt_verify_budget = int(os.environ.get(
+            "ALPA_TPU_SUPEROPT_VERIFY_BUDGET", "2"))
+        # Transfer-fission cap: max members per batched same-edge
+        # RESHARD group (0 = unlimited, the historical coalescer
+        # behavior).  Oversized groups serialize behind the
+        # overlap_inflight_window; capping lets the search split them.
+        self.superopt_max_group = int(os.environ.get(
+            "ALPA_TPU_SUPEROPT_MAX_GROUP", "0"))
+
         # ---------- elastic training (ISSUE 16) ----------
         # ElasticSupervisor budgets (alpa_tpu/elastic.py; see
         # docs/fault_tolerance.md#elastic-training).  Step budget: max

@@ -193,6 +193,10 @@ def dump_debug_info(executable, dump_dir: str):
     # axioms used, term-diff witnesses on mismatch
     if hasattr(executable, "get_equiv_text"):
         write("equiv.txt", executable.get_equiv_text())
+    # certified superoptimization (ISSUE 17): rewrite decision, before/
+    # after simulated critical path + peak bytes, gate rejections
+    if hasattr(executable, "get_superopt_text"):
+        write("superopt.txt", executable.get_superopt_text())
     # post-step perf analysis (ISSUE 9): critical path, bubbles, MFU
     if hasattr(executable, "get_perf_report_text"):
         write("perf_report.txt", executable.get_perf_report_text())

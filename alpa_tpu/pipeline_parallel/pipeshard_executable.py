@@ -617,6 +617,12 @@ class PipeshardDriverExecutable:
         self._reg_const_loads = None
         self._reg_acc_slots = None
         self._reg_output_specs = None
+        # certified superoptimization (ISSUE 17): the one-shot rewrite
+        # decision (analysis/superopt.py SuperoptOutcome) and, when a
+        # rewrite was accepted in auto mode, the rewritten instruction
+        # list every lowering mode shares (identical slot_of).
+        self._superopt_outcome = None
+        self._superopt_instructions = None
         self._warned_register_fallback = False
         # quiesce gate: fault.RecoveryManager pauses new launches and
         # waits out in-flight ones before snapshotting driver state
@@ -887,16 +893,16 @@ class PipeshardDriverExecutable:
         hint = getattr(self.schedule, "overlap_window_hint", None)
         return int(hint()) if callable(hint) else max(2, self.num_meshes)
 
-    def _ensure_lowered(self, mode: str = "registers"):
-        """Lower the instruction list into a RegisterFileProgram (once
-        per mode) and precompute the launch-time slot tables: input
-        loads, const loads, accumulator slots, and output slots — so the
-        replay loop touches only integer-indexed lists.  Phase-1 lowering
-        is mode-independent, so every mode's program has identical
-        ``slot_of`` and the slot tables are shared."""
-        prog = self._register_programs.get(mode)
-        if prog is not None:
-            return prog
+    def _make_lowerer(self, mode: str = "registers"):
+        """Build the lowering closure for one mode: derives the static
+        sharding seed, opt-state/provenance/protected key sets, and the
+        equivalence reference from THIS executable, and returns
+        ``lower(instructions) -> RegisterFileProgram``.  Shared by
+        ``_ensure_lowered`` and the superopt engine (ISSUE 17), which
+        lowers candidate instruction lists through the same context —
+        so a rewritten program carries coherent OpHook/dataflow/
+        PlanModel metadata and is verified against the same reference.
+        """
         from alpa_tpu.pipeline_parallel.runtime_emitter import (
             lower_to_register_file)
         n_mb = self.num_micro_batches
@@ -974,27 +980,67 @@ class PipeshardDriverExecutable:
         # stage applications over (var, microbatch) value keys —
         # deliberately derived here, before lowering, so the certifier
         # proves the register program against an independent artifact
+        superopt_active = getattr(
+            global_config, "superopt_mode", "off") in ("suggest", "auto")
         equiv_reference = None
-        if getattr(global_config, "verify_plans", "warn") != "off" and \
+        if (getattr(global_config, "verify_plans", "warn") != "off" or
+                superopt_active) and \
                 getattr(global_config, "verify_plans_equiv",
                         "warn") != "off":
             from alpa_tpu.analysis import equivalence as _equiv
             equiv_reference = _equiv.build_reference(
                 self.instructions, n_mb)
-        prog = lower_to_register_file(self.instructions, preplaced,
-                                      mode=mode,
-                                      overlap_window=self._overlap_window(),
-                                      protected_keys=frozenset(protected),
-                                      opt_state_keys=frozenset(
-                                          opt_state_keys),
-                                      provenance_keys=provenance_keys,
-                                      equiv_reference=equiv_reference)
+
+        def _lower(insts):
+            # the equivalence reference stays derived from the ORIGINAL
+            # driver stream above, so translation validation proves any
+            # superopt rewrite still computes the source jaxpr.  With
+            # superopt active the verdict gate needs verified programs,
+            # so verify_plans=off is upgraded to warn for the lowering.
+            old_verify = global_config.verify_plans
+            try:
+                if superopt_active and old_verify == "off":
+                    global_config.verify_plans = "warn"
+                return lower_to_register_file(
+                    insts, preplaced, mode=mode,
+                    overlap_window=self._overlap_window(),
+                    protected_keys=frozenset(protected),
+                    opt_state_keys=frozenset(opt_state_keys),
+                    provenance_keys=provenance_keys,
+                    equiv_reference=equiv_reference)
+            finally:
+                global_config.verify_plans = old_verify
+
+        return _lower
+
+    def _ensure_lowered(self, mode: str = "registers"):
+        """Lower the instruction list into a RegisterFileProgram (once
+        per mode) and precompute the launch-time slot tables: input
+        loads, const loads, accumulator slots, and output slots — so the
+        replay loop touches only integer-indexed lists.  Phase-1 lowering
+        is mode-independent, so every mode's program has identical
+        ``slot_of`` and the slot tables are shared."""
+        prog = self._register_programs.get(mode)
+        if prog is not None:
+            return prog
+        _lower = self._make_lowerer(mode)
+        superopt_active = getattr(
+            global_config, "superopt_mode", "off") in ("suggest", "auto")
+        prog = None
+        if superopt_active and self._superopt_outcome is None:
+            prog = self._run_superopt(_lower)
+        if prog is None:
+            prog = _lower(self._superopt_instructions
+                          if self._superopt_instructions is not None
+                          else self.instructions)
         self._register_programs[mode] = prog
         if mode == "registers":
             self._register_program = prog
         slot_of = prog.slot_of
         if self._reg_input_loads is not None:
             return prog
+        n_mb = self.num_micro_batches
+        ginvar_idx = {v: i for i, v in enumerate(self.global_invars)}
 
         # input placement: (flat arg index, is_batch, [(slot, sharding,
         # microbatch)]) — resolved once, replayed every launch
@@ -1028,6 +1074,54 @@ class PipeshardDriverExecutable:
                                 meshes)))
         self._reg_output_specs = out_specs
         return prog
+
+    def _run_superopt(self, lower):
+        """One-shot certified-superoptimization decision (ISSUE 17;
+        analysis/superopt.py).  Lowers the baseline, runs the cached/
+        searched rewrite engine with the seven-analysis verdict gate,
+        and — in auto mode with an accepted rewrite — stores the
+        rewritten instruction list so every later lowering mode shares
+        it (identical ``slot_of``).  Returns the program to use for the
+        calling mode, or None to fall through to a plain lowering."""
+        from alpa_tpu.analysis import superopt as _superopt
+        from alpa_tpu.analysis.plan_verifier import PlanVerdict
+        smode = getattr(global_config, "superopt_mode", "off")
+        baseline = lower(self.instructions)
+
+        def _verify(p, _insts):
+            v = getattr(p, "verdict", None)
+            return v if v is not None else PlanVerdict()
+
+        try:
+            outcome = _superopt.run_superopt(
+                list(self.instructions), self.num_meshes, baseline,
+                lower, _verify, mode=smode)
+        except Exception:  # pylint: disable=broad-except
+            logger.exception(
+                "superopt: engine failed; keeping the baseline plan")
+            self._superopt_outcome = _superopt.SuperoptOutcome(
+                mode=smode, searched=True, cache_hit=False,
+                accepted=False,
+                layout=_superopt.identity_layout(
+                    len(self.instructions)),
+                baseline_score=_superopt.PlanScore(0.0, ()),
+                best_score=_superopt.PlanScore(0.0, ()),
+                baseline_fingerprint=baseline.fingerprint(),
+                fingerprint=None, rejected=[("superopt", "engine-error")],
+                log=[])
+            return baseline
+        self._superopt_outcome = outcome
+        if smode == "auto" and outcome.accepted and \
+                outcome.instructions is not None:
+            self._superopt_instructions = list(outcome.instructions)
+            return outcome.program
+        return baseline
+
+    def get_superopt_text(self) -> str:
+        """Human-readable superopt decision report (``superopt.txt``
+        in monitoring.dump_debug_info; scripts/perf_tool.py superopt)."""
+        from alpa_tpu.analysis import superopt as _superopt
+        return _superopt.format_superopt_report(self._superopt_outcome)
 
     def _launch_registers(self, flat_args, mode: str = "registers"):
         """Replay the lowered register-file program: flat list reads and
@@ -1631,6 +1725,10 @@ class PipeshardDriverExecutable:
             modes = list(self._register_programs)
             self._register_programs.clear()
             self._register_program = None
+            # the instruction stream changed: any accepted superopt
+            # layout no longer applies — re-decide on the new stream
+            self._superopt_outcome = None
+            self._superopt_instructions = None
             for m in modes:
                 self._ensure_lowered(m)
         verdict["applied"] = bool(flips)

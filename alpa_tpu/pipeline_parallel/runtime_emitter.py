@@ -1424,6 +1424,11 @@ def lower_to_register_file(
 
     if mode == "registers":
         # ---- phase 2a: flat replay with extended same-edge coalescing ----
+        # group-membership legality lives in ONE oracle shared with the
+        # superopt fusion family (analysis/superopt.py, ISSUE 17);
+        # superopt_max_group > 0 is the fission knob.  Lazy import:
+        # analysis/ sits above the lowering layer.
+        from alpa_tpu.analysis.superopt import reshard_group_extent
         i = 0
         while i < n:
             r = recs[i]
@@ -1436,31 +1441,10 @@ def lower_to_register_file(
                 i += 1
                 continue
             edge = r["edge"]
-            members: List[int] = []             # rec indices in the group
-            hopped: List[int] = []              # FREEs emitted post-group
-            blocked: set = set()                # slots freed by hopped FREEs
-            counted = 0                         # hopped FREEs with a member
-                                                # appended after them
-            j = i
-            while j < n:
-                q = recs[j]
-                if (q["kind"] == "RESHARD" and q["edge"] == edge and
-                        (j == i or (r.get("groupable", True) and
-                                    q.get("groupable", True)))):
-                    if q["ss"] in blocked or q["ds"] in blocked:
-                        break   # would reorder past a FREE of its slots
-                    if len(hopped) > counted:
-                        n_free_hops += len(hopped) - counted
-                        counted = len(hopped)
-                    members.append(j)
-                    j += 1
-                    continue
-                if q["kind"] == "FREE":
-                    hopped.append(j)
-                    blocked.update(q["slots"])
-                    j += 1
-                    continue
-                break
+            members, hopped, hops, j = reshard_group_extent(
+                recs, i,
+                max_members=global_config.superopt_max_group)
+            n_free_hops += hops
             # trailing FREEs (after the last member) keep their original
             # relative position by being re-emitted after the group
             if len(members) == 1:

@@ -1,0 +1,265 @@
+"""Certified plan superoptimization benchmark (ISSUE 17): adversarial
+deoptimized plan -> superopt_mode=auto recovery -> bitwise outputs ->
+warm-restart cache replay.
+
+Real pipeshard plans come out of the emitter already well-scheduled, so
+the bench measures the engine against the hazard-legal adversarial
+baseline ``deoptimize_instructions`` produces: a topological reorder of
+the full hazard DAG (RAW/WAR/WAW, per-channel FIFO order, and the
+production-order invariant all hold — the program is semantically
+identical) with inverted list-scheduling priority and every FREE
+deferred as late as legality allows.  That is a plan a register-file
+emitter *could* legally have produced; ``superopt_mode=auto`` must then
+recover it:
+
+1. Compile a real 2-stage / 2-mesh pipeshard MLP (8 CPU devices) and
+   run one training step — the reference parameter bytes.
+2. Hot-swap the deoptimized instruction stream into the executable
+   (the replan path: forget lowered programs + slot tables) and verify
+   the step is STILL bitwise identical — the adversary is semantics-
+   preserving, only slower and fatter.
+3. ``superopt_mode=auto``: the beam search + seven-analysis verdict
+   gate accept a rewrite with a strictly smaller simulated critical
+   path AND strictly smaller simulated peak live bytes; the step stays
+   bitwise identical.
+4. Warm restart (fresh compile-cache memory tier over the same disk
+   dir): the accepted decision replays with zero search and an
+   identical rewritten-plan fingerprint.
+5. Fixture cross-check (satellite 1): on the committed
+   ``model_check_fixture_plan.json``, ``simulate_dag``'s per-mesh
+   simulated peak-live-bytes equals the static liveness analysis'
+   ``alpa_plan_peak_bytes`` bit for bit.
+
+Usage:  python benchmark/superopt_bench.py [--out F] [--gate]
+
+``--gate`` checks the ``superopt.*`` metrics against
+``benchmark/results/perf_gate_baseline.json`` (critical-path ratio and
+peak-bytes ratio <= 1.0, bitwise outputs, zero-search warm replay) and
+exits nonzero on regression.  Writes benchmark/results/superopt.json.
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from alpa_tpu.platform import pin_cpu_platform  # noqa: E402
+
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results", "superopt.json")
+FIXTURE = os.path.join(REPO, "benchmark", "results",
+                       "model_check_fixture_plan.json")
+
+
+def _fresh_pair():
+    from alpa_tpu.testing import create_mlp_train_state_and_batch
+    return create_mlp_train_state_and_batch(
+        batch_size=8, input_dim=8, hidden_dim=8, output_dim=8,
+        num_layers=4, manual_pipeline_layer=False)
+
+
+def _leaves(state):
+    import jax
+    import numpy as np
+    return [np.asarray(x) for x in
+            jax.tree_util.tree_leaves(state.params)]
+
+
+def _bitwise(a, b):
+    return float(len(a) == len(b) and
+                 all((x == y).all() for x, y in zip(a, b)))
+
+
+def _forget_lowering(ex):
+    """The replan hot-swap: drop every lowered program AND the slot
+    tables (instruction order changed, so slot numbering changes)."""
+    ex._register_programs.clear()
+    ex._register_program = None
+    ex._reg_input_loads = None
+    ex._reg_const_loads = None
+    ex._reg_acc_slots = None
+    ex._reg_output_specs = None
+    ex._superopt_outcome = None
+    ex._superopt_instructions = None
+
+
+def _fixture_leg() -> dict:
+    """Satellite 1: simulated per-mesh peaks == static liveness peaks
+    on the committed fixture, serialized in program order."""
+    from alpa_tpu.analysis import plan_verifier as pv
+    from alpa_tpu.analysis.critical_path import MemSpec, simulate_dag
+    from alpa_tpu.analysis.model_check import model_from_dict
+    with open(FIXTURE, encoding="utf-8") as f:
+        model, _hooks, _window = model_from_dict(json.load(f))
+    slots = (model.slots.values() if isinstance(model.slots, dict)
+             else model.slots)
+    written, preplaced = set(), set()
+    for op in model.ops:
+        for s in list(op.reads) + list(op.kills):
+            if s not in written:
+                preplaced.add(s)
+        written.update(op.writes)
+    mem = MemSpec(writes=[list(o.writes) for o in model.ops],
+                  kills=[list(o.kills) for o in model.ops],
+                  nbytes={s.slot: float(s.nbytes) for s in slots},
+                  mesh_of={s.slot: s.mesh for s in slots},
+                  num_meshes=model.num_meshes,
+                  preplaced=frozenset(preplaced))
+    n = len(model.ops)
+    _, _, sim_peaks = simulate_dag(
+        [1.0] * n, [set() if i == 0 else {i - 1} for i in range(n)], mem)
+    _, stats = pv.check_liveness(model)
+    static = stats["peak_bytes"]
+    static_list = [static[str(m)] for m in range(model.num_meshes)] \
+        if isinstance(static, dict) else list(static)
+    return {
+        "simulated_peak_bytes": list(sim_peaks),
+        "static_peak_bytes": static_list,
+        "match": float(list(sim_peaks) == static_list),
+    }
+
+
+def run() -> dict:
+    import alpa_tpu
+    from alpa_tpu import PipeshardParallel
+    from alpa_tpu.analysis import superopt as so
+    from alpa_tpu.compile_cache import reset_compile_cache
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        AutoLayerOption)
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.testing import get_mlp_train_step
+
+    prev = {k: getattr(global_config, k) for k in (
+        "pipeline_dispatch_mode", "superopt_mode", "compile_cache_dir")}
+    cache_dir = tempfile.mkdtemp(prefix="superopt_bench_cache_")
+    try:
+        alpa_tpu.init("local")
+        global_config.pipeline_dispatch_mode = "registers"
+        global_config.superopt_mode = "off"
+        global_config.compile_cache_dir = cache_dir
+        reset_compile_cache()
+
+        method = PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=AutoLayerOption(layer_num=4),
+            stage_option=UniformStageOption(num_stages=2))
+        step = get_mlp_train_step(method, use_value_and_grad=False)
+        state, batch = _fresh_pair()
+        step(state, batch)
+        ex = step.get_last_executable()
+
+        s0, b0 = _fresh_pair()
+        ns0, _ = step(s0, b0)
+        want = _leaves(ns0)
+
+        # 2. the adversarial baseline, hot-swapped
+        cm = so._CostModel()
+        nm = ex.num_meshes
+        original = so.score_instructions(list(ex.instructions), nm, cm)
+        ex.instructions = so.deoptimize_instructions(
+            list(ex.instructions), cm)
+        pessimized = so.score_instructions(list(ex.instructions), nm, cm)
+        _forget_lowering(ex)
+        ex._ensure_lowered("registers")
+        s1, b1 = _fresh_pair()
+        ns1, _ = step(s1, b1)
+        pess_bitwise = _bitwise(want, _leaves(ns1))
+
+        # 3. auto recovery through the verdict gate
+        global_config.superopt_mode = "auto"
+        _forget_lowering(ex)
+        ex._ensure_lowered("registers")
+        out = ex._superopt_outcome
+        s2, b2 = _fresh_pair()
+        ns2, _ = step(s2, b2)
+        auto_bitwise = _bitwise(want, _leaves(ns2))
+        cp_ratio = (out.best_score.makespan_us /
+                    out.baseline_score.makespan_us)
+        peak_ratio = (out.best_score.total_peak /
+                      out.baseline_score.total_peak)
+
+        # 4. warm restart: fresh memory tier, same disk cache
+        reset_compile_cache()
+        _forget_lowering(ex)
+        ex._ensure_lowered("registers")
+        warm = ex._superopt_outcome
+        s3, b3 = _fresh_pair()
+        ns3, _ = step(s3, b3)
+        warm_bitwise = _bitwise(want, _leaves(ns3))
+
+        fixture = _fixture_leg()
+
+        gate_metrics = {
+            "superopt.accepted": float(bool(out.accepted)),
+            "superopt.critical_path_ratio": round(cp_ratio, 4),
+            "superopt.peak_bytes_ratio": round(peak_ratio, 4),
+            "superopt.outputs_bitwise": min(
+                pess_bitwise, auto_bitwise, warm_bitwise),
+            "superopt.warm_replay_zero_search": float(
+                warm.cache_hit and not warm.searched and
+                warm.fingerprint == out.fingerprint),
+            "superopt.sim_peaks_match_static": fixture["match"],
+        }
+        return {
+            "plan": {
+                "n_instructions": len(ex.instructions),
+                "num_meshes": nm,
+                "original": original.to_dict(),
+                "deoptimized": pessimized.to_dict(),
+                "deopt_makespan_inflation": round(
+                    pessimized.makespan_us / original.makespan_us, 4),
+                "deopt_peak_inflation": round(
+                    pessimized.total_peak / original.total_peak, 4),
+            },
+            "superopt": out.to_dict(),
+            "bitwise": {
+                "deoptimized": pess_bitwise,
+                "auto": auto_bitwise,
+                "warm": warm_bitwise,
+            },
+            "warm_restart": {
+                "cache_hit": warm.cache_hit,
+                "searched": warm.searched,
+                "fingerprint_stable":
+                    warm.fingerprint == out.fingerprint,
+            },
+            "fixture": fixture,
+            "gate_metrics": gate_metrics,
+        }
+    finally:
+        reset_compile_cache()
+        for k, v in prev.items():
+            setattr(global_config, k, v)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--gate", action="store_true",
+                        help="check superopt.* metrics against the "
+                             "committed perf-gate baseline")
+    args = parser.parse_args()
+
+    pin_cpu_platform(8)
+    result = run()
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(result, indent=2, sort_keys=True))
+    print(f"\nwrote {args.out}")
+
+    if args.gate:
+        from benchmark.perf_gate import gate
+        verdict = gate(result["gate_metrics"])
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit("SUPEROPT BENCH PERF GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()

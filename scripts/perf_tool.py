@@ -9,6 +9,17 @@ Usage::
                                              [--name SUBSTR]
     python scripts/perf_tool.py compare      A.json B.json
     python scripts/perf_tool.py drift        [TRACE.json] [--top N] [--json]
+    python scripts/perf_tool.py superopt     [--dir DIR] [--all] [--json]
+
+``superopt`` prints every accepted certified-superoptimization rewrite
+decision found in the compile cache's disk tier (ISSUE 17; the engine
+caches accepted rewrites under the ``superopt`` namespace at compile
+time) — before/after simulated critical path and peak bytes, the
+rewritten-plan fingerprint, and the admissible-candidate search log.
+The cache directory comes from ``--dir``, else ``ALPA_TPU_CACHE_DIR``.
+For the verdict side of a rewrite (which findings the gate compared),
+``scripts/verify_tool.py verify diff`` diffs two cached verdicts with
+the same ``(analysis, code)``-set semantics the acceptance gate uses.
 
 ``analyze`` prints the full :class:`StepPerfReport` (critical path,
 per-mesh bubble fractions, transfer overlap, stage MFU where RUN spans
@@ -177,6 +188,53 @@ def cmd_drift(args):
         print(_cal.format_calibration_report(store))
 
 
+def cmd_superopt(args):
+    from alpa_tpu.analysis import superopt as _superopt
+    cache = None
+    if args.dir:
+        from alpa_tpu.compile_cache import CompileCache
+        cache = CompileCache(cache_dir=args.dir)
+    cached = _superopt.load_cached_decisions(cache)
+    if not cached:
+        where = args.dir or os.environ.get("ALPA_TPU_CACHE_DIR") or (
+            "(memory only — set ALPA_TPU_CACHE_DIR)")
+        sys.exit(f"no cached superopt decisions in {where}; accepted "
+                 f"rewrites are cached at compile time when "
+                 f"superopt_mode != off")
+    shown = cached if args.all else cached[:1]
+    if args.json:
+        print(json.dumps({"schema": "alpa-superopt/v1",
+                          "decisions": [{"key": e["key"],
+                                         "mtime": e["mtime"],
+                                         **e["decision"]}
+                                        for e in shown]},
+                         indent=2, sort_keys=True, default=str))
+        return
+    for e in shown:
+        d = e["decision"]
+        base_peak = sum(d.get("baseline_peak_bytes", ()))
+        peak = sum(d.get("peak_bytes", ()))
+        print(f"== superopt {e['key'][:16]}.. ==")
+        print(f"  baseline plan: {d.get('baseline_fingerprint', '?')[:16]}"
+              f"  rewritten plan: {d.get('fingerprint', '?')[:16]}")
+        print(f"  simulated critical path: "
+              f"{d.get('baseline_makespan_us', 0.0):.1f} -> "
+              f"{d.get('makespan_us', 0.0):.1f} us")
+        print(f"  simulated peak bytes:    {base_peak:.0f} -> "
+              f"{peak:.0f}")
+        n_rewrites = sum(1 for i, x in enumerate(d.get("layout", ()))
+                         if not isinstance(x, int) or x != i)
+        print(f"  non-identity layout entries: {n_rewrites}")
+        for entry in d.get("log", ())[-10:]:
+            print(f"    {entry.get('family', '?'):<16} makespan "
+                  f"{entry.get('makespan_us', 0.0):.1f} us, peak "
+                  f"{entry.get('peak_bytes', 0.0):.0f} B")
+        print()
+    if not args.all and len(cached) > 1:
+        print(f"({len(cached) - 1} older decision(s) cached; "
+              f"--all to show)")
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     sub = p.add_subparsers(dest="command", required=True)
@@ -219,6 +277,18 @@ def main(argv=None):
     pd.add_argument("--json", action="store_true",
                     help="machine-readable drift table")
     pd.set_defaults(func=cmd_drift)
+
+    ps = sub.add_parser(
+        "superopt", help="cached certified-superoptimization rewrite "
+        "decisions: before/after simulated cost + accepted rewrite "
+        "log (ISSUE 17)")
+    ps.add_argument("--dir", default=None,
+                    help="compile cache dir (default ALPA_TPU_CACHE_DIR)")
+    ps.add_argument("--all", action="store_true",
+                    help="show every cached decision, not just newest")
+    ps.add_argument("--json", action="store_true",
+                    help="machine-readable decisions")
+    ps.set_defaults(func=cmd_superopt)
 
     args = p.parse_args(argv)
     args.func(args)

@@ -5,6 +5,8 @@ Usage::
     python scripts/verify_tool.py verify plan [--dir DIR] [--all] [--json]
     python scripts/verify_tool.py verify zero-delta [--dir DIR]
                                                     [--a KEY --b KEY] [--json]
+    python scripts/verify_tool.py verify diff [--dir DIR]
+                                              [--a KEY --b KEY] [--json]
     python scripts/verify_tool.py verify lint [--json]
     python scripts/verify_tool.py modelcheck [--fixture PATH]
                                              [--budget N] [--json]
@@ -120,6 +122,14 @@ to see what the sharded weight-update layout saves: per-mesh
 ``peak_bytes`` delta, per-mesh ``opt_state_bytes`` ratio, and the
 verifier's ``zero_bytes_saved`` total (docs/performance.md).  Defaults
 to the two newest verdicts; ``--a``/``--b`` select by key prefix.
+
+``verify diff`` diffs two cached verdicts with the exact
+``(analysis, code)``-set semantics the certified-superoptimization
+acceptance gate uses (ISSUE 17; one diff implementation —
+``alpa_tpu.analysis.superopt.verdict_diff`` — shared with the engine):
+new findings, resolved findings, and the ACCEPT/REJECT verdict the
+gate would reach.  Exit status 1 on REJECT.  Defaults to newest-vs-
+second-newest (older = baseline); ``--a``/``--b`` select by prefix.
 """
 import argparse
 import json
@@ -333,6 +343,45 @@ def cmd_equiv(args):
         sys.exit(1)
 
 
+def cmd_diff(args):
+    """Diff two cached verdicts with the exact ``(analysis, code)``-set
+    semantics the superopt acceptance gate uses (ISSUE 17;
+    ``alpa_tpu.analysis.superopt.verdict_diff`` is the one diff
+    implementation, shared with the engine)."""
+    from alpa_tpu.analysis.superopt import verdict_diff
+    cached = _load_verdicts(args)
+    if len(cached) < 2:
+        sys.exit(f"need two cached verdicts to diff, found "
+                 f"{len(cached)}; set ALPA_TPU_CACHE_DIR and compile "
+                 f"both plans into it")
+    if args.a or args.b:
+        if not (args.a and args.b):
+            sys.exit("--a and --b must be given together")
+        ea, eb = _pick(cached, args.a, "--a"), _pick(cached, args.b,
+                                                     "--b")
+    else:
+        eb, ea = cached[0], cached[1]     # older = baseline
+    diff = verdict_diff(ea["verdict"], eb["verdict"])
+    diff["baseline_key"] = ea["key"]
+    diff["candidate_key"] = eb["key"]
+    if args.json:
+        print(json.dumps({"schema": "alpa-verdict-diff/v1", **diff},
+                         indent=2, sort_keys=True))
+    else:
+        print(f"baseline  {ea['key'][:16]}..  "
+              f"({len(diff['baseline_findings'])} findings)")
+        print(f"candidate {eb['key'][:16]}..  "
+              f"({len(diff['candidate_findings'])} findings)")
+        print(f"new findings (gate-rejecting): "
+              f"{', '.join(diff['new']) or '(none)'}")
+        print(f"resolved findings: "
+              f"{', '.join(diff['resolved']) or '(none)'}")
+        print(f"gate verdict: "
+              f"{'ACCEPT' if diff['ok'] else 'REJECT'}")
+    if not diff["ok"]:
+        sys.exit(1)
+
+
 def cmd_lint(args):
     from alpa_tpu.analysis import lint
     violations = lint.run_lint()
@@ -371,6 +420,18 @@ def main():
                    help="key prefix of the sharded (zero_stage=2) plan")
     z.add_argument("--json", action="store_true")
     z.set_defaults(fn=cmd_zero_delta)
+    d = vsub.add_parser(
+        "diff",
+        help="diff two cached verdicts with the superopt acceptance "
+             "gate's (analysis, code)-set semantics (ISSUE 17)")
+    d.add_argument("--dir", default=None,
+                   help="compile cache dir (default: $ALPA_TPU_CACHE_DIR)")
+    d.add_argument("--a", default=None,
+                   help="key prefix of the baseline verdict")
+    d.add_argument("--b", default=None,
+                   help="key prefix of the candidate verdict")
+    d.add_argument("--json", action="store_true")
+    d.set_defaults(fn=cmd_diff)
     l = vsub.add_parser("lint", help="run the AST repo lint")
     l.add_argument("--json", action="store_true")
     l.set_defaults(fn=cmd_lint)
