@@ -416,6 +416,37 @@ class GlobalConfig:
         self.router_autoscale_lo_queue = float(os.environ.get(
             "ALPA_TPU_ROUTER_AUTOSCALE_LO_QUEUE", "1"))
 
+        # ---------- serving: disaggregated prefill/decode (ISSUE 18) -
+        # Phase-split serving (serve.disagg): "off" keeps the monolithic
+        # path byte-identical; "auto" splits whenever the router has at
+        # least one prefill-phase AND one decode-phase replica; "forced"
+        # requires both pools and sheds (503) when either is missing.
+        self.disagg_mode = os.environ.get("ALPA_TPU_DISAGG_MODE", "off")
+        # KV handoff payload codec over the wire: "off" ships the block
+        # bytes verbatim (bit-exact decode, the default); "int8"/"fp8"
+        # ride the reshard_codec blockwise quantizer (lossy within its
+        # ERROR_BOUND — docs/serving.md#disaggregated-prefilldecode).
+        self.disagg_codec = os.environ.get("ALPA_TPU_DISAGG_CODEC", "off")
+        # Decode-pool backpressure: when the decode pool's aggregate
+        # depth (queued + in-flight) exceeds this, NEW prefill
+        # admissions shed (503) — handoffs already produced are never
+        # dropped.  0 disables.
+        self.disagg_backpressure_depth = int(os.environ.get(
+            "ALPA_TPU_DISAGG_BACKPRESSURE_DEPTH", "0"))
+        # Prefill-pool SLO: route around a prefill replica whose
+        # router-measured TTFT p99 exceeds this (ms).  0 disables.
+        self.disagg_ttft_slo_ms = float(os.environ.get(
+            "ALPA_TPU_DISAGG_TTFT_SLO_MS", "0"))
+        # Decode-pool SLO: route around a decode replica whose
+        # inter-token p99 exceeds this (ms).  0 disables.
+        self.disagg_itl_slo_ms = float(os.environ.get(
+            "ALPA_TPU_DISAGG_ITL_SLO_MS", "0"))
+        # Handoff artifacts retained per prefill engine for corrupt-
+        # artifact re-fetch / decode-replica re-ingest (LRU once full;
+        # the router acks artifacts as streams finish).
+        self.disagg_retain_artifacts = int(os.environ.get(
+            "ALPA_TPU_DISAGG_RETAIN_ARTIFACTS", "64"))
+
         # ---------- checkpointing ----------
         # Local cache dir drained asynchronously to the shared FS
         # (ref: DaemonMoveWorker).

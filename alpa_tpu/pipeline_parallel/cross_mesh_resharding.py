@@ -1225,6 +1225,21 @@ def make_transfer(aval, src_sharding, dst_sharding, cross=False,
         return DirectTransfer(aval, src_sharding, dst_sharding)
 
 
+def make_ingest_transfer(aval, dst_sharding):
+    """Transfer executor landing a HOST-resident payload on the
+    destination sharding — the arrival half of a cross-process edge
+    whose source lives in another address space (the disaggregated
+    KV handoff, serve.disagg: the prefill replica's payload arrives as
+    numpy and must land exactly where the decode engine's resident
+    caches live).  A plain :class:`DirectTransfer` with no source
+    sharding: the fast copy path is off, ``device_put`` lands it, and
+    the wire-emulation knobs (``resharding_transfer_latency_s``,
+    ``resharding_wire_bandwidth``) still model the hop."""
+    t = DirectTransfer(aval, None, dst_sharding)
+    t.wire = (1, float(t.nbytes))
+    return t
+
+
 @dataclasses.dataclass
 class ExecutionReport:
     """Bytes actually moved by one ``ReshardingTask.run`` call.

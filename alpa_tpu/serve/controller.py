@@ -13,7 +13,13 @@ Endpoints:
   POST /completions                     -> {"model", "prompt_ids",
         "max_new_tokens"?, "temperature"?, "top_k"?, "do_sample"?}
         => {"output_ids": [[...]]}
-  GET  /health                          -> {"status": "ok" | "degraded"
+  POST /disagg/prefill                  -> same body as /completions;
+        => KV handoff artifact wire JSON (serve.disagg)
+  POST /disagg/ingest                   -> artifact wire JSON; => SSE
+        token stream joining the decode batch
+  POST /disagg/fetch | /disagg/ack      -> {"request_id"}: retained-
+        artifact re-ingest source / release
+  GET  /health                        -> {"status": "ok" | "degraded"
         | "shedding"} (503 when shedding; see docs/fault_tolerance.md)
   GET  /healthz                         -> recovery-state liveness probe
         (200 healthy/suspect/recovering, 503 degraded;
@@ -246,6 +252,7 @@ class _Replica:
         #: the streaming engine is built (kv_paged + kv_prefix_reuse)
         self.warm_prefix_ids = warm_prefix_ids
         self._engine = None
+        self._prefill_engine = None
         self._lock = threading.Lock()
 
     @property
@@ -293,6 +300,11 @@ class _Replica:
                         " leaving the old engine to finish them on the "
                         "new weights", drain_timeout)
                 self._engine = None  # next stream builds a fresh engine
+            if self._prefill_engine is not None:
+                # prefill-pool KV (and retained handoff artifacts) are
+                # only valid for the params that produced them
+                self._prefill_engine.shutdown()
+                self._prefill_engine = None
 
     @property
     def engine(self):
@@ -331,6 +343,34 @@ class _Replica:
                 if pool is not None and self.warm_prefix_ids is not None:
                     pool.warm_prefix(self.generator, self.warm_prefix_ids)
             return self._engine
+
+    @property
+    def prefill_engine(self):
+        """Lazy prefill-only engine for the disaggregated prefill pool
+        (serve.disagg).  Mirrors the decode engine's admission exactly
+        (same prompt bucket, same prefix-hit path), so the handoff
+        artifact carries bit-identical KV to what the monolithic engine
+        would have computed in place.  A static-PrefixHandle replica
+        cannot serve the prefill pool (block tables need kv_paged +
+        kv_prefix_reuse semantics)."""
+        with self._lock:
+            if self._prefill_engine is None:
+                if self.prefix is not None:
+                    raise fault.ServiceDegradedError(
+                        "replica runs a static PrefixHandle; the "
+                        "disaggregated prefill pool needs paged KV "
+                        "(kv_paged + kv_prefix_reuse)")
+                from alpa_tpu.serve.disagg import PrefillEngine
+                sched = (self.scheduler_factory()
+                         if self.scheduler_factory else None)
+                self._prefill_engine = PrefillEngine(
+                    self.generator,
+                    prompt_bucket=self.generator.prompt_buckets[-1],
+                    scheduler=sched)
+                if self.warm_prefix_ids is not None:
+                    self._prefill_engine.pool.warm_prefix(
+                        self.generator, self.warm_prefix_ids)
+            return self._prefill_engine
 
 
 class Controller:
@@ -391,6 +431,9 @@ class Controller:
             replicas = [r for reps in self._models.values() for r in reps]
         for rep in replicas:
             depth += len(rep.batcher._queue)
+            pe = rep._prefill_engine
+            if pe is not None:
+                depth += pe.queue_depth()
             eng = rep._engine
             if eng is None:
                 continue
@@ -615,6 +658,62 @@ class Controller:
         return replica.engine.submit_stream(prompt_ids.reshape(-1), cfg,
                                             queue=queue)
 
+    # -- disaggregated prefill/decode (serve.disagg) -------------------
+
+    def disagg_prefill(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefill-phase half of a disaggregated request: admit + run
+        the prompt's prefill on this replica's prefill pool and return
+        the (retained) handoff artifact's wire form."""
+        tic = time.monotonic()
+        replica, prompt_ids, cfg, queue = self._parse_request(request)
+        if prompt_ids.ndim > 1 and prompt_ids.shape[0] != 1:
+            raise ValueError(
+                "disaggregated prefill takes exactly one prompt per "
+                f"request; got {prompt_ids.shape[0]} rows")
+        pe = replica.prefill_engine
+        pe.model = request["model"]
+        art = pe.prefill(prompt_ids.reshape(-1), cfg, queue=queue)
+        self._latencies.append(time.monotonic() - tic)
+        return art.to_wire()
+
+    def disagg_ingest(self, wire: Dict[str, Any]):
+        """Decode-phase half: verify the artifact (any hash mismatch
+        raises ArtifactCorruptError — corrupt KV is never decoded),
+        land it on a replica's decode engine, and return the token
+        stream that joins the continuous decode batch mid-tick."""
+        from alpa_tpu.serve import disagg
+        self._check_shedding()
+        art = disagg.KVHandoffArtifact.from_wire(wire)  # verifies
+        name = art.model
+        if name not in self._models:
+            raise KeyError(f"unknown model {name!r}; "
+                           f"registered: {self.list_models()}")
+        replica = self._pick_replica(name)
+        return disagg.ingest_stream(replica.engine, art)
+
+    def disagg_fetch(self, request_id: str) -> Dict[str, Any]:
+        """The retained artifact for ``request_id`` — the router's
+        re-ingest source after a decode-side failure."""
+        with self._lock:
+            replicas = [r for reps in self._models.values()
+                        for r in reps]
+        for rep in replicas:
+            pe = rep._prefill_engine
+            if pe is not None:
+                art = pe.fetch(request_id)
+                if art is not None:
+                    return art.to_wire()
+        raise KeyError(f"no retained artifact {request_id!r}")
+
+    def disagg_ack(self, request_id: str) -> bool:
+        """Drop the retained artifact: its decode stream finished."""
+        with self._lock:
+            replicas = [r for reps in self._models.values()
+                        for r in reps]
+        return any(rep._prefill_engine is not None and
+                   rep._prefill_engine.ack(request_id)
+                   for rep in replicas)
+
 
 class _Handler(BaseHTTPRequestHandler):
     controller: Controller = None  # set by run_controller
@@ -699,6 +798,9 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/admin/reload":
             self._admin_reload()
             return
+        if self.path.startswith("/disagg/"):
+            self._disagg()
+            return
         if self.path != "/completions":
             self._send(404, {"error": f"unknown path {self.path}"})
             return
@@ -759,6 +861,49 @@ class _Handler(BaseHTTPRequestHandler):
         never a second status line into the open SSE body.
         """
         it = self.controller.completions_stream(request)  # validates
+        self._stream_body(it)
+
+    def _disagg(self):
+        """Disaggregation endpoints (serve.disagg / serve.router):
+        ``/disagg/prefill`` -> handoff artifact wire JSON;
+        ``/disagg/ingest`` -> SSE token stream joining the decode
+        batch; ``/disagg/fetch`` + ``/disagg/ack`` manage the prefill
+        side's retained artifacts.  A corrupt artifact maps to 422 so
+        the router re-fetches the retained copy instead of failing the
+        request."""
+        from alpa_tpu.serve.disagg import ArtifactCorruptError
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            request = json.loads(self.rfile.read(length) or b"{}")
+            if self.path == "/disagg/prefill":
+                self._send(200,
+                           self.controller.disagg_prefill(request))
+            elif self.path == "/disagg/ingest":
+                it = self.controller.disagg_ingest(request)  # validates
+                self._stream_body(it)
+            elif self.path == "/disagg/fetch":
+                self._send(200, self.controller.disagg_fetch(
+                    str(request.get("request_id"))))
+            elif self.path == "/disagg/ack":
+                self._send(200, {"acked": self.controller.disagg_ack(
+                    str(request.get("request_id")))})
+            else:
+                self._send(404, {"error": f"unknown path {self.path}"})
+        except fault.ServiceDegradedError as e:
+            self._send(503, {"error": str(e)})
+        except ArtifactCorruptError as e:
+            self._send(422, {"error": str(e)})
+        except KeyError as e:
+            self._send(404, {"error": str(e)})
+        except (json.JSONDecodeError, ValueError, AssertionError,
+                TypeError) as e:
+            self._send(400, {"error": f"bad request: {e}"})
+        except Exception as e:  # pylint: disable=broad-except
+            logger.exception("disagg request failed")
+            self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _stream_body(self, it):
+        """Write an already-validated token iterator as SSE."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")

@@ -280,12 +280,61 @@ class ContinuousBatchingEngine:
         # abandoned request is retired instead of burning a KV row
         return _TokenStream(item, q)
 
-    def _make_item(self, prompt, cfg, on_token, on_done=None, queue=None):
+    def submit_prefilled(self, prompt: np.ndarray,
+                         cfg: Optional[GenerationConfig],
+                         caches1, logits1, on_token=None,
+                         queue: Optional[str] = None) -> np.ndarray:
+        """Blocking decode for a request whose prefill ALREADY ran
+        elsewhere (disaggregated serving, serve.disagg): ``caches1`` is
+        the dense single-row per-layer ``[(k, v, index)]`` state
+        positioned at the prompt length and ``logits1`` the last-token
+        logits — exactly what the in-engine prefill would have produced,
+        so decode stays bit-exact vs the monolithic path.  The row joins
+        the continuous decode batch at the next admission point
+        (mid-tick: between decode steps, never waiting out other
+        generations)."""
+        item = self._make_item(prompt, cfg, on_token, queue=queue,
+                               prefilled=(logits1, caches1))
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+        item["done"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        row = np.asarray(item["tokens"], np.int32)
+        return np.concatenate([item["prompt"], row])
+
+    def submit_prefilled_stream(self, prompt: np.ndarray,
+                                cfg: Optional[GenerationConfig],
+                                caches1, logits1,
+                                queue: Optional[str] = None):
+        """Streaming variant of :meth:`submit_prefilled` (the decode
+        half of a disaggregated handoff)."""
+        import queue as _queue
+
+        q: "_queue.Queue" = _queue.Queue()
+        item = self._make_item(prompt, cfg, q.put,
+                               on_done=lambda: q.put(_STREAM_END),
+                               queue=queue, prefilled=(logits1, caches1))
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+        return _TokenStream(item, q)
+
+    def _make_item(self, prompt, cfg, on_token, on_done=None, queue=None,
+                   prefilled=None):
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         cfg = cfg or GenerationConfig()
         seq_len = self.gen.config.seq_len
         plen = self._prefix.length if self._prefix is not None else 0
-        if len(prompt) > self.bucket:
+        if prefilled is not None and self._prefix is not None:
+            raise ValueError(
+                "prefilled admission is incompatible with a static "
+                "PrefixHandle engine (ingested caches carry the full "
+                "prompt)")
+        if prefilled is None and len(prompt) > self.bucket:
+            # prefilled rows never run this engine's prefill, so the
+            # prefill bucket does not constrain them (seq_len does)
             raise ValueError(
                 f"prompt {len(prompt)} exceeds engine bucket "
                 f"{self.bucket}")
@@ -316,7 +365,7 @@ class ContinuousBatchingEngine:
         return {"prompt": prompt, "cfg": cfg, "tokens": [],
                 "done": _DoneEvent(on_done), "error": None,
                 "on_token": on_token, "cancelled": False,
-                "queue": queue or "default",
+                "queue": queue or "default", "prefilled": prefilled,
                 "t_submit": time.monotonic()}
 
     def shutdown(self):
@@ -422,7 +471,14 @@ class ContinuousBatchingEngine:
             item = self._queue.popleft()
             try:
                 p = item["prompt"]
-                if seq is not None and seq.matched_tokens:
+                if item.get("prefilled") is not None:
+                    # disaggregated handoff: the prefill ran on another
+                    # replica; its dense row state lands here unchanged
+                    # (bit-identical to what this engine's own prefill
+                    # would produce — serve.disagg pins this)
+                    logits1, caches1 = item["prefilled"]
+                    item["prefilled"] = None  # drop the reference
+                elif seq is not None and seq.matched_tokens:
                     # prefix-reuse hit: gather the cached blocks into a
                     # dense row and prefill ONLY the suffix from the
                     # match offset (gather moves bits unchanged; the

@@ -470,6 +470,25 @@ class KVBlockPool:
         idx = jnp.asarray([seq.matched_tokens], jnp.int32)
         return [(k, v, idx) for (k, v) in outs]
 
+    def gather_blocks(self, seq: PagedSequence, num_blocks: int):
+        """Materialize the first ``num_blocks`` blocks of ``seq`` as
+        host block arrays ``[(k, v)]`` per layer, each shaped
+        ``(num_blocks, block_size, ...)`` — the block-table slice a
+        disaggregated prefill replica ships to a decode replica
+        (serve.disagg).  Gather moves bits unchanged, so the handoff
+        payload is exactly what the pool holds."""
+        ids, mask = self._padded_ids(seq.ids, 0, num_blocks)
+        with self._lock:
+            outs = self._gather_jit(self._kp, self._vp, ids, mask)
+        bs = self.block_size
+        res = []
+        for (k, v) in outs:
+            kk = np.asarray(k)[0, :num_blocks * bs]
+            vv = np.asarray(v)[0, :num_blocks * bs]
+            res.append((kk.reshape((num_blocks, bs) + kk.shape[1:]),
+                        vv.reshape((num_blocks, bs) + vv.shape[1:])))
+        return res
+
     def scatter_prompt(self, seq: PagedSequence, dense_caches):
         """Store the freshly prefilled prompt region (dense single-row
         caches) into the sequence's NEW blocks — matched blocks already

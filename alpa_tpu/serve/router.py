@@ -27,9 +27,24 @@ load_report``, also exported on every controller's ``/healthz``):
   ``on_want_fewer`` callbacks (the operator's scale signal; the router
   itself never creates replicas).
 
+* Disaggregated prefill/decode (ISSUE 18): replicas join with a
+  ``phase`` (``prefill``/``decode``/``any``).  With ``disagg_mode=auto``
+  (and both strict pools present) or ``forced``, a request is prefilled
+  on the prefill pool, handed off as a content-hashed
+  :class:`~alpa_tpu.serve.disagg.KVHandoffArtifact`, and decoded on the
+  decode pool.  Each pool gets its own SLO steer (``disagg_ttft_slo_ms``
+  for prefill, ``disagg_itl_slo_ms`` for decode inter-token p99), decode
+  backlog throttles prefill admission
+  (``disagg_backpressure_depth``), and no handoff is ever dropped: the
+  prefill side retains every artifact until the stream's clean end is
+  acked, so a decode-replica death or a corrupt wire copy re-ingests on
+  a survivor (docs/serving.md#disaggregated-prefilldecode).
+  ``disagg_mode=off`` is byte-identical to the monolithic path.
+
 :class:`RouterServer` puts the same router behind HTTP (``/completions``
-incl. SSE on local replicas, ``/healthz`` with the per-replica view,
-``/metrics``, ``POST /admin/rolling_reload``).
+incl. SSE pass-through for both local and HTTP replicas, ``/healthz``
+with the per-replica view, ``/metrics``,
+``POST /admin/rolling_reload``).
 """
 import json
 import logging
@@ -43,6 +58,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 from alpa_tpu import fault
 from alpa_tpu.global_env import global_config
+from alpa_tpu.serve import disagg as _disagg
 from alpa_tpu.telemetry import metrics as _tmetrics
 
 logger = logging.getLogger(__name__)
@@ -90,6 +106,68 @@ class LocalReplicaHandle:
                step: Optional[int] = None) -> Dict[str, Any]:
         return self.controller.reload_model(model, ckpt_dir, step=step)
 
+    # disaggregated prefill/decode (same surface as HTTPReplicaHandle)
+    def prefill(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        return self.controller.disagg_prefill(request)
+
+    def ingest(self, wire: Dict[str, Any]):
+        return self.controller.disagg_ingest(wire)
+
+    def disagg_fetch(self, request_id: str) -> Dict[str, Any]:
+        return self.controller.disagg_fetch(request_id)
+
+    def disagg_ack(self, request_id: str) -> bool:
+        return self.controller.disagg_ack(request_id)
+
+
+class _SSEStream:
+    """Client half of the controller/router SSE wire format: iterates
+    token ints from ``data: {"token": t}`` frames, raises on an error
+    frame, and raises :class:`ConnectionError` when the transport dies
+    before the ``done`` frame — exactly the signal the disaggregated
+    failover path (and the router's health accounting) keys on."""
+
+    def __init__(self, resp):
+        self._resp = resp
+        self._closed = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        while True:
+            try:
+                line = self._resp.readline()
+            except (OSError, urllib.error.URLError):
+                self.close()
+                raise
+            if not line:
+                self.close()
+                raise ConnectionError(
+                    "SSE stream ended before its done frame")
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            evt = json.loads(line[len(b"data:"):].strip())
+            if evt.get("done"):
+                self.close()
+                raise StopIteration
+            if "error" in evt:
+                self.close()
+                raise RuntimeError(str(evt["error"]))
+            if "token" in evt:
+                return int(evt["token"])
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._resp.close()
+            except Exception:  # pylint: disable=broad-except
+                pass
+
 
 class HTTPReplicaHandle:
     """Remote replica behind ``http://host:port`` (a running
@@ -136,10 +214,61 @@ class HTTPReplicaHandle:
                 f"{body.get('error')}")
         return body
 
+    def _post_stream(self, path: str, payload: Dict[str, Any]):
+        body = json.dumps(payload).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                err = json.loads(e.read()).get("error", "")
+            except Exception:  # pylint: disable=broad-except
+                err = ""
+            if e.code == 503:
+                raise fault.ServiceDegradedError(
+                    err or "replica shedding") from e
+            if e.code == 422:
+                raise _disagg.ArtifactCorruptError(
+                    err or "handoff artifact rejected") from e
+            raise RuntimeError(
+                f"replica {self.base_url} returned {e.code}: "
+                f"{err}") from e
+        return _SSEStream(resp)
+
     def completions_stream(self, request: Dict[str, Any]):
-        raise NotImplementedError(
-            "SSE pass-through is only wired for local replicas; point "
-            "streaming clients at the replica controller directly")
+        return self._post_stream("/completions",
+                                 dict(request, stream=True))
+
+    # disaggregated prefill/decode surface
+    def prefill(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        code, body = self._post("/disagg/prefill", request)
+        if code == 503:
+            raise fault.ServiceDegradedError(
+                body.get("error", "prefill replica shedding"))
+        if code != 200:
+            raise RuntimeError(
+                f"prefill on {self.base_url} returned {code}: "
+                f"{body.get('error')}")
+        return body
+
+    def ingest(self, wire: Dict[str, Any]):
+        return self._post_stream("/disagg/ingest", wire)
+
+    def disagg_fetch(self, request_id: str) -> Dict[str, Any]:
+        code, body = self._post("/disagg/fetch",
+                                {"request_id": request_id})
+        if code != 200:
+            raise KeyError(
+                f"no retained artifact {request_id!r} on "
+                f"{self.base_url} ({code}: {body.get('error')})")
+        return body
+
+    def disagg_ack(self, request_id: str) -> bool:
+        code, body = self._post("/disagg/ack",
+                                {"request_id": request_id})
+        return code == 200 and bool(body.get("acked"))
 
     def healthz(self):
         return self._get("/healthz")
@@ -161,32 +290,41 @@ class HTTPReplicaHandle:
         return body
 
 
-class _ReplicaState:
-    __slots__ = ("name", "handle", "healthy", "draining", "fails",
-                 "inflight", "last_load", "latencies")
+def _p99_ms(samples) -> Optional[float]:
+    lat = sorted(samples)
+    if not lat:
+        return None
+    return lat[int(0.99 * (len(lat) - 1))] * 1e3
 
-    def __init__(self, name: str, handle):
+
+class _ReplicaState:
+    __slots__ = ("name", "handle", "phase", "healthy", "draining",
+                 "fails", "inflight", "last_load", "latencies", "itls")
+
+    def __init__(self, name: str, handle, phase: str = "any"):
         self.name = name
         self.handle = handle
+        self.phase = phase
         self.healthy = True
         self.draining = False
         self.fails = 0
         self.inflight = 0
         self.last_load: Dict[str, Any] = {}
         self.latencies = deque(maxlen=256)
+        #: router-observed inter-token gaps (disagg decode pool SLO)
+        self.itls = deque(maxlen=512)
 
     def view(self) -> Dict[str, Any]:
-        lat = sorted(self.latencies)
         return {"healthy": self.healthy, "draining": self.draining,
+                "phase": self.phase,
                 "inflight": self.inflight,
                 "consecutive_failures": self.fails,
                 "queue_depth": self.last_load.get("queue_depth"),
                 "tokens_in_flight":
                     self.last_load.get("tokens_in_flight"),
                 "ttft_p99_ms": self.last_load.get("ttft_p99_ms"),
-                "router_p99_ms":
-                    lat[int(0.99 * (len(lat) - 1))] * 1e3 if lat
-                    else None}
+                "router_p99_ms": _p99_ms(self.latencies),
+                "itl_p99_ms": _p99_ms(self.itls)}
 
 
 class _RoutedStream:
@@ -220,6 +358,139 @@ class _RoutedStream:
             self._end()
 
 
+def _flatten_ids(x) -> List[int]:
+    out: List[int] = []
+
+    def rec(v):
+        if isinstance(v, (list, tuple)):
+            for e in v:
+                rec(e)
+        else:
+            out.append(int(v))
+    rec(x if x is not None else [])
+    return out
+
+
+class _DisaggStream:
+    """A routed disaggregated stream: carries the decode replica's
+    in-flight guard, observes the per-pool TTFT/ITL histograms, and —
+    because the prefill side retains the artifact until the clean end
+    is acked — survives a decode-replica death mid-stream by
+    re-ingesting on a survivor and fast-forwarding the replay (greedy
+    decode is deterministic, so the replayed prefix is checked
+    token-for-token; sampled streams propagate the failure instead)."""
+
+    def __init__(self, router, decode_st, prefill_st, wire, inner,
+                 t0, do_sample):
+        self._router = router
+        self._dst = decode_st
+        self._pst = prefill_st
+        self._wire = wire
+        self._inner = inner
+        self._t0 = t0
+        self._do_sample = do_sample
+        self._emitted: List[int] = []
+        self._replay = 0
+        self._last = None
+        self._ended = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        r = self._router
+        while True:
+            if self._ended:
+                raise StopIteration
+            try:
+                t = next(self._inner)
+            except StopIteration:
+                self._end(ack=True)
+                raise
+            except (OSError, urllib.error.URLError) as e:
+                self._failover(e)
+                continue
+            except BaseException:
+                self._end(ack=False)
+                raise
+            if self._replay:
+                k = len(self._emitted) - self._replay
+                if int(t) != self._emitted[k]:
+                    self._end(ack=False)
+                    raise RuntimeError(
+                        "re-ingested decode stream diverged from the "
+                        "already-emitted prefix")
+                self._replay -= 1
+                continue
+            now = r._clock()
+            if self._last is None:
+                _disagg.observe_ttft("prefill", now - self._t0)
+            else:
+                gap = now - self._last
+                _disagg.observe_itl("decode", gap)
+                self._dst.itls.append(gap)
+            self._last = now
+            self._emitted.append(int(t))
+            return int(t)
+
+    def _failover(self, err):
+        r = self._router
+        dead = self._dst
+        try:
+            self._inner.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        with r._lock:
+            dead.inflight -= 1
+            dead.fails += 1
+            if dead.fails >= r.health_fail_threshold:
+                dead.healthy = False
+        _ROUTER_REQS.labels(dead.name, "error").inc()
+        if self._do_sample:
+            # sampled decode cannot replay deterministically; surface
+            # the failure (the artifact stays retained for a manual or
+            # client-driven retry)
+            self._ended = True
+            raise err
+        logger.warning(
+            "router: decode replica %s died mid-stream (%s); "
+            "re-ingesting the retained handoff", dead.name, err)
+        r.disagg_reingests += 1
+        _disagg.count_reingest("decode_died")
+        wire = self._wire
+        try:
+            wire = self._pst.handle.disagg_fetch(wire["request_id"])
+        except Exception:  # pylint: disable=broad-except
+            logger.warning(
+                "router: re-fetch from the prefill side failed; using "
+                "the router's in-memory copy")
+        dst, inner = r._disagg_ingest(self._pst, wire,
+                                      exclude={dead.name})
+        self._dst, self._inner = dst, inner
+        self._replay = len(self._emitted)
+
+    def _end(self, ack: bool):
+        if self._ended:
+            return
+        self._ended = True
+        with self._router._lock:
+            self._dst.inflight -= 1
+        if ack:
+            self._dst.fails = 0
+            try:
+                self._pst.handle.disagg_ack(self._wire["request_id"])
+            except Exception:  # pylint: disable=broad-except
+                logger.warning("router: disagg ack failed for %s",
+                               self._wire.get("request_id"))
+
+    def close(self):
+        try:
+            self._inner.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+        self._end(ack=True)
+
+
 class Router:
     """Spread admission across replicas; see the module docstring."""
 
@@ -230,6 +501,10 @@ class Router:
                  autoscale_window_s: Optional[float] = None,
                  autoscale_hi_queue: Optional[float] = None,
                  autoscale_lo_queue: Optional[float] = None,
+                 disagg_mode: Optional[str] = None,
+                 disagg_backpressure_depth: Optional[int] = None,
+                 disagg_ttft_slo_ms: Optional[float] = None,
+                 disagg_itl_slo_ms: Optional[float] = None,
                  clock: Callable[[], float] = time.monotonic):
         self.policy = policy or global_config.router_policy
         if self.policy not in ("least_loaded", "round_robin"):
@@ -263,15 +538,37 @@ class Router:
         self._as_samples: "deque" = deque()
         self._as_last_fire = -float("inf")
         self.sheds = 0
+        # ---- disaggregated prefill/decode (ISSUE 18) ----
+        self.disagg_mode = (global_config.disagg_mode
+                            if disagg_mode is None else disagg_mode)
+        if self.disagg_mode not in ("off", "auto", "forced"):
+            raise ValueError(
+                f"unknown disagg_mode {self.disagg_mode!r}")
+        self.disagg_backpressure_depth = (
+            global_config.disagg_backpressure_depth
+            if disagg_backpressure_depth is None
+            else disagg_backpressure_depth)
+        self.disagg_ttft_slo_ms = (
+            global_config.disagg_ttft_slo_ms
+            if disagg_ttft_slo_ms is None else disagg_ttft_slo_ms)
+        self.disagg_itl_slo_ms = (
+            global_config.disagg_itl_slo_ms
+            if disagg_itl_slo_ms is None else disagg_itl_slo_ms)
+        self.disagg_handoffs = 0
+        self.disagg_reingests = 0
+        self.disagg_backpressure_sheds = 0
 
     # ---- membership -------------------------------------------------
 
-    def add_replica(self, name: str, handle) -> None:
+    def add_replica(self, name: str, handle,
+                    phase: str = "any") -> None:
+        if phase not in ("any", "prefill", "decode"):
+            raise ValueError(f"unknown replica phase {phase!r}")
         with self._lock:
             if name in self._replicas:
                 raise ValueError(f"replica {name!r} already registered")
-            self._replicas[name] = _ReplicaState(name, handle)
-        logger.info("router: added replica %s", name)
+            self._replicas[name] = _ReplicaState(name, handle, phase)
+        logger.info("router: added replica %s (phase=%s)", name, phase)
 
     def remove_replica(self, name: str) -> None:
         with self._lock:
@@ -341,14 +638,34 @@ class Router:
                 0.01 * (load.get("tokens_in_flight") or 0) +
                 0.001 * (load.get("ttft_p99_ms") or 0.0))
 
-    def _pick(self, exclude) -> Optional[_ReplicaState]:
+    def _slo_violated(self, st: _ReplicaState, phase: str) -> bool:
+        """Phase SLO steer: prefer replicas inside their pool's SLO
+        (router-observed TTFT p99 for prefill, inter-token p99 for
+        decode).  A steer, not a shed — when every candidate violates,
+        least-loaded placement still proceeds."""
+        if phase == "prefill" and self.disagg_ttft_slo_ms:
+            p99 = _p99_ms(st.latencies)
+            return p99 is not None and p99 > self.disagg_ttft_slo_ms
+        if phase == "decode" and self.disagg_itl_slo_ms:
+            p99 = _p99_ms(st.itls)
+            return p99 is not None and p99 > self.disagg_itl_slo_ms
+        return False
+
+    def _pick(self, exclude,
+              phase: Optional[str] = None) -> Optional[_ReplicaState]:
         with self._lock:
             cands = [st for st in self._replicas.values()
                      if st.healthy and not st.draining
-                     and st.name not in exclude]
+                     and st.name not in exclude
+                     and (phase is None or
+                          st.phase in ("any", phase))]
         for st in cands:
             self._refresh_load(st)
         cands = [st for st in cands if not self._saturated(st)]
+        if phase is not None and cands:
+            inside_slo = [st for st in cands
+                          if not self._slo_violated(st, phase)]
+            cands = inside_slo or cands
         if not cands:
             return None
         if self.policy == "round_robin":
@@ -363,6 +680,11 @@ class Router:
         """Route one completion request, failing over across replicas.
         Raises ServiceDegradedError (HTTP 503) only when no routable
         replica remains un-saturated."""
+        if self._disagg_active():
+            stream = self._submit_disagg_stream(request)
+            toks = list(stream)
+            return {"output_ids":
+                    [_flatten_ids(request.get("prompt_ids")) + toks]}
         excluded: set = set()
         self._observe_autoscale()
         while True:
@@ -408,9 +730,11 @@ class Router:
             return out
 
     def submit_stream(self, request: Dict[str, Any]):
-        """Route a streaming request (local replicas only).  The stream
-        counts as in-flight until exhausted or closed, so rolling
+        """Route a streaming request (local or HTTP replicas).  The
+        stream counts as in-flight until exhausted or closed, so rolling
         deploys drain it before touching its replica."""
+        if self._disagg_active():
+            return self._submit_disagg_stream(request)
         self._observe_autoscale()
         st = self._pick(set())
         if st is None:
@@ -433,6 +757,160 @@ class Router:
             with self._lock:
                 st.inflight -= 1
         return _RoutedStream(inner, on_end)
+
+    # ---- disaggregated prefill/decode -------------------------------
+
+    def _disagg_active(self) -> bool:
+        """Whether requests take the split prefill/decode path.
+        ``off`` short-circuits before touching any disagg state, so the
+        monolithic path is byte-identical to a router without this
+        feature."""
+        mode = self.disagg_mode
+        if mode == "off":
+            return False
+        if mode == "forced":
+            return True
+        with self._lock:
+            phases = {st.phase for st in self._replicas.values()
+                      if st.healthy}
+        return "prefill" in phases and "decode" in phases
+
+    def _decode_pool_depth(self) -> int:
+        with self._lock:
+            sts = [st for st in self._replicas.values()
+                   if st.healthy and st.phase in ("any", "decode")]
+        depth = 0
+        for st in sts:
+            self._refresh_load(st)
+            depth += (st.last_load.get("queue_depth") or 0) + \
+                st.inflight
+        return depth
+
+    def _submit_disagg_stream(self, request: Dict[str, Any]):
+        self._observe_autoscale()
+        # decode-pool backpressure throttles PREFILL admission: work
+        # already prefilled is never dropped, new work sheds up front
+        depth = self._decode_pool_depth()
+        if self.disagg_backpressure_depth and \
+                depth > self.disagg_backpressure_depth:
+            self.sheds += 1
+            self.disagg_backpressure_sheds += 1
+            _disagg.count_backpressure_shed()
+            _ROUTER_REQS.labels("none", "shed").inc()
+            raise fault.ServiceDegradedError(
+                f"decode pool backpressure (depth {depth} > "
+                f"{self.disagg_backpressure_depth}); prefill admission "
+                f"throttled")
+        t0 = self._clock()
+        pst, wire = self._disagg_prefill(request)
+        handoff_t0 = self._clock()
+        dst, inner = self._disagg_ingest(pst, wire)
+        _disagg.observe_handoff(self._clock() - handoff_t0)
+        self.disagg_handoffs += 1
+        return _DisaggStream(self, dst, pst, wire, inner, t0,
+                             bool(request.get("do_sample")))
+
+    def _disagg_prefill(self, request: Dict[str, Any]):
+        """Run the prefill phase with the same failover taxonomy as
+        :meth:`submit`; returns (replica_state, artifact wire dict)."""
+        excluded: set = set()
+        while True:
+            st = self._pick(excluded, phase="prefill")
+            if st is None:
+                self.sheds += 1
+                _ROUTER_REQS.labels("none", "shed").inc()
+                raise fault.ServiceDegradedError(
+                    "no prefill replica can take the request")
+            with self._lock:
+                st.inflight += 1
+            tic = self._clock()
+            try:
+                wire = st.handle.prefill(request)
+            except fault.ServiceDegradedError:
+                _ROUTER_REQS.labels(st.name, "shed").inc()
+                excluded.add(st.name)
+                continue
+            except (OSError, urllib.error.URLError) as e:
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                with self._lock:
+                    st.fails += 1
+                    if st.fails >= self.health_fail_threshold:
+                        st.healthy = False
+                logger.warning(
+                    "router: prefill replica %s errored (%s); failing "
+                    "over", st.name, e)
+                excluded.add(st.name)
+                continue
+            except Exception:
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                raise
+            finally:
+                with self._lock:
+                    st.inflight -= 1
+            st.fails = 0
+            st.latencies.append(self._clock() - tic)
+            return st, wire
+
+    def _disagg_ingest(self, pst: _ReplicaState, wire: Dict[str, Any],
+                       exclude=()):
+        """Ingest the handoff on the decode pool.  A corrupt artifact
+        is re-fetched from the prefill side's retained copy (never
+        silently decoded); a dead decode replica is health-counted and
+        the handoff re-ingests on a survivor."""
+        excluded: set = set(exclude)
+        refetches = 0
+        while True:
+            st = self._pick(excluded, phase="decode")
+            if st is None:
+                self.sheds += 1
+                _ROUTER_REQS.labels("none", "shed").inc()
+                raise fault.ServiceDegradedError(
+                    "no decode replica can ingest the handoff")
+            with self._lock:
+                st.inflight += 1
+            try:
+                inner = st.handle.ingest(wire)
+            except _disagg.ArtifactCorruptError:
+                with self._lock:
+                    st.inflight -= 1
+                self.disagg_reingests += 1
+                _disagg.count_reingest("corrupt")
+                if refetches >= 2:
+                    raise
+                refetches += 1
+                logger.warning(
+                    "router: decode replica %s rejected corrupt "
+                    "handoff %s; re-fetching the retained artifact",
+                    st.name, wire.get("request_id"))
+                wire = pst.handle.disagg_fetch(wire["request_id"])
+                continue
+            except fault.ServiceDegradedError:
+                with self._lock:
+                    st.inflight -= 1
+                _ROUTER_REQS.labels(st.name, "shed").inc()
+                excluded.add(st.name)
+                continue
+            except (OSError, urllib.error.URLError) as e:
+                with self._lock:
+                    st.inflight -= 1
+                    st.fails += 1
+                    if st.fails >= self.health_fail_threshold:
+                        st.healthy = False
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                self.disagg_reingests += 1
+                _disagg.count_reingest("replica_failed")
+                logger.warning(
+                    "router: decode replica %s failed ingest (%s); "
+                    "re-ingesting on a survivor", st.name, e)
+                excluded.add(st.name)
+                continue
+            except Exception:
+                with self._lock:
+                    st.inflight -= 1
+                _ROUTER_REQS.labels(st.name, "error").inc()
+                raise
+            _ROUTER_REQS.labels(st.name, "ok").inc()
+            return st, inner
 
     # ---- rolling deploys --------------------------------------------
 
@@ -544,7 +1022,17 @@ class Router:
                 "replicas": {st.name: st.view() for st in states},
                 "sheds": self.sheds,
                 "want_more_signals": self.want_more_signals,
-                "want_fewer_signals": self.want_fewer_signals}
+                "want_fewer_signals": self.want_fewer_signals,
+                "disagg": {
+                    "mode": self.disagg_mode,
+                    "active": self._disagg_active(),
+                    "pools": {ph: sorted(st.name for st in states
+                                         if st.phase == ph)
+                              for ph in ("prefill", "decode", "any")},
+                    "handoffs": self.disagg_handoffs,
+                    "reingests": self.disagg_reingests,
+                    "backpressure_sheds":
+                        self.disagg_backpressure_sheds}}
 
 
 class _RouterHandler(BaseHTTPRequestHandler):

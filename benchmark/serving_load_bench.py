@@ -17,9 +17,18 @@ Three scenarios, one committed artifact
 * ``rolling`` — hammer 2 replicas while ``Router.rolling_reload`` swaps
   weights one replica at a time; records completed requests and
   failures (pinned 0).
+* ``disagg`` (``--disagg``, ISSUE 18; own artifact
+  ``benchmark/results/serving_disagg.json``) — mixed long-prefill /
+  short-decode Poisson workload against (a) a monolithic 2-replica
+  fleet and (b) a disaggregated 1-prefill + 2-decode fleet, with one
+  decode replica KILLED mid-run.  Records decode inter-token p99 per
+  fleet (long chunked prefills convoy the monolithic engine's decode
+  ticks; the disaggregated decode pool only pays an ingest scatter),
+  TTFT p99, handoff KB/request, re-ingest count, and failures across
+  the kill (pinned 0: no handoff is ever dropped).
 
     python benchmark/serving_load_bench.py [--requests 32] [--seed 0]
-        [--out benchmark/results/serving_load.json] [--gate]
+        [--out benchmark/results/serving_load.json] [--gate] [--disagg]
 
 ``--gate`` flattens the scenario metrics under ``serving.*`` and checks
 them against ``benchmark/results/perf_gate_baseline.json``
@@ -380,6 +389,263 @@ def bench_rolling(seed, tmp_dir):
             "replicas": 2}
 
 
+class _KillableIter:
+    """Stream wrapper that dies with its replica: once the kill switch
+    is set, the next ``__next__`` raises like a dropped connection."""
+
+    def __init__(self, handle, inner):
+        self.handle = handle
+        self.inner = inner
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.handle.dead.is_set():
+            raise ConnectionError("decode replica killed (bench)")
+        return next(self.inner)
+
+    def close(self):
+        close = getattr(self.inner, "close", None)
+        if close is not None:
+            close()
+
+
+class _KillableHandle:
+    """Decode-replica handle with a kill switch: when ``dead`` is set,
+    new ingests fail and already-open streams raise mid-iteration —
+    the shape of a decode-replica crash the router must absorb with
+    zero dropped handoffs."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.dead = threading.Event()
+
+    def _check(self):
+        if self.dead.is_set():
+            raise ConnectionError("decode replica killed (bench)")
+
+    def completions(self, request):
+        self._check()
+        return self.inner.completions(request)
+
+    def completions_stream(self, request):
+        self._check()
+        return _KillableIter(self, self.inner.completions_stream(request))
+
+    def ingest(self, wire):
+        self._check()
+        return _KillableIter(self, self.inner.ingest(wire))
+
+    def prefill(self, request):
+        self._check()
+        return self.inner.prefill(request)
+
+    def disagg_fetch(self, request_id):
+        return self.inner.disagg_fetch(request_id)
+
+    def disagg_ack(self, request_id):
+        return self.inner.disagg_ack(request_id)
+
+    def healthz(self):
+        return self.inner.healthz()
+
+    def load(self):
+        return self.inner.load()
+
+    def reload(self, model, ckpt_dir, step=None):
+        return self.inner.reload(model, ckpt_dir, step=step)
+
+
+def _mixed_trace(n, rng, heavy_frac=0.35, heavy_prompt=320,
+                 light_prompt=8, heavy_new=4, light_new=24, rate_hz=5.0):
+    """Mixed long-prefill / short-decode Poisson trace (the workload
+    disaggregation exists for): heavy requests are prefill-dominated,
+    light requests are decode-dominated and carry the ITL samples."""
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n))
+    reqs = []
+    for i in range(n):
+        heavy = bool(rng.random() < heavy_frac)
+        size = heavy_prompt if heavy else light_prompt
+        prompt = rng.randint(2, 250, size=size).astype(np.int32)
+        reqs.append((float(arrivals[i]), prompt.tolist(),
+                     heavy_new if heavy else light_new, heavy))
+    return reqs
+
+
+def _drive_mixed(router, trace, kill_at=None, on_kill=None):
+    """Replay the mixed trace open-loop through the router.  TTFT is
+    recorded for every request; inter-token gaps only for the light
+    (short-decode) population — heavy streams emit too few tokens to
+    say anything about steady-state ITL.  ``on_kill`` fires once, when
+    ``kill_at`` requests have completed."""
+    res = {"ttfts": [], "gaps": [], "errors": []}
+    done = {"n": 0}
+    lock = threading.Lock()
+    t0 = time.perf_counter()
+
+    def run(arrival, prompt, max_new, heavy):
+        wait = arrival - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        sent = time.perf_counter()
+        try:
+            it = router.submit_stream(
+                {"model": "m", "prompt_ids": prompt,
+                 "max_new_tokens": max_new, "temperature": 0.0})
+            first, last, gaps, n_toks = None, None, [], 0
+            for _ in it:
+                now = time.perf_counter()
+                if first is None:
+                    first = now - sent
+                else:
+                    gaps.append(now - last)
+                last = now
+                n_toks += 1
+            assert n_toks == max_new
+            with lock:
+                res["ttfts"].append(first)
+                if not heavy:
+                    res["gaps"].extend(gaps)
+                done["n"] += 1
+                if on_kill is not None and done["n"] == kill_at:
+                    on_kill()
+        except Exception as e:  # pylint: disable=broad-except
+            with lock:
+                res["errors"].append(repr(e))
+
+    threads = [threading.Thread(target=run, args=args)
+               for args in trace]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    res["wall"] = time.perf_counter() - t0
+    return res
+
+
+def _disagg_generator():
+    # a model where a full-bucket prefill visibly stalls a decode tick
+    return _tiny_generator(seq_len=512, prefill_chunk=16, hidden=128,
+                           layers=2)
+
+
+def _disagg_summary(res):
+    return {
+        "requests_ok": len(res["ttfts"]),
+        "failures": len(res["errors"]),
+        "ttft_p50_ms": round(_percentile(res["ttfts"], 0.5) * 1e3, 2),
+        "ttft_p99_ms": round(_percentile(res["ttfts"], 0.99) * 1e3, 2),
+        "itl_p50_ms": round(_percentile(res["gaps"], 0.5) * 1e3, 2),
+        "itl_p99_ms": round(_percentile(res["gaps"], 0.99) * 1e3, 2),
+        "itl_samples": len(res["gaps"]),
+        "wall_s": round(res["wall"], 2),
+    }
+
+
+def bench_disagg(n_requests, seed):
+    """Monolithic 3-replica fleet vs 1 prefill + 2 decode disaggregated
+    fleet (equal total hardware) on the same mixed trace; one disagg
+    decode replica is killed mid-run (every in-flight and future
+    request on it must fail over via the retained handoff — zero
+    failures)."""
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.serve import disagg as disagg_mod
+    from alpa_tpu.serve.controller import Controller
+    from alpa_tpu.serve.router import LocalReplicaHandle, Router
+
+    warm_heavy = list(range(2, 162))
+    warm_light = list(range(2, 10))
+
+    def controller():
+        gen, _m, _p, _c = _disagg_generator()
+        ctrl = Controller()
+        ctrl.register_model("m", gen)
+        return ctrl
+
+    def warm_engine(ctrl):
+        # compile the bucketed prefill + decode-step executables
+        # outside the measured window (both prompt sizes share one
+        # prompt bucket, but warm both populations anyway)
+        for p in (warm_heavy, warm_light):
+            list(ctrl.completions_stream(
+                {"model": "m", "prompt_ids": p, "max_new_tokens": 2,
+                 "temperature": 0.0}))
+
+    prev = (global_config.kv_paged, global_config.kv_prefix_reuse)
+    # paged KV on for both fleets (the disagg ingest scatters into the
+    # paged pool); prefix reuse off — every prompt is unique, and one
+    # code path per fleet keeps compile noise out of the percentiles
+    global_config.kv_paged = True
+    global_config.kv_prefix_reuse = False
+    try:
+        rng = np.random.RandomState(seed + 4)
+        trace = _mixed_trace(n_requests, rng)
+        out = {"trace": {
+            "requests": n_requests,
+            "heavy": sum(1 for *_x, h in trace if h),
+            "light": sum(1 for *_x, h in trace if not h),
+        }}
+
+        # -- monolithic: every replica prefills AND decodes ------------
+        mono_router = Router(disagg_mode="off")
+        for i in range(3):
+            ctrl = controller()
+            warm_engine(ctrl)
+            mono_router.add_replica(f"r{i}", LocalReplicaHandle(ctrl))
+        mono = _drive_mixed(mono_router, trace)
+        out["monolithic"] = _disagg_summary(mono)
+
+        # -- disaggregated: 1 prefill + 2 decode, d0 killed mid-run ----
+        router = Router(disagg_mode="auto")
+        cp = controller()
+        router.add_replica("p0", LocalReplicaHandle(cp),
+                           phase="prefill")
+        kill = None
+        for i in range(2):
+            ctrl = controller()
+            warm_engine(ctrl)
+            handle = LocalReplicaHandle(ctrl)
+            if i == 0:
+                handle = _KillableHandle(handle)
+                kill = handle
+            router.add_replica(f"d{i}", handle, phase="decode")
+        # warm the handoff path end to end on BOTH decode replicas
+        # (prefill bucket on p0; ingest transfer + mid-tick join on dX)
+        p0 = router._replicas["p0"].handle
+        for name in ("d0", "d1"):
+            wire = p0.prefill({"model": "m", "prompt_ids": warm_light,
+                               "max_new_tokens": 2, "temperature": 0.0})
+            list(router._replicas[name].handle.ingest(wire))
+            p0.disagg_ack(wire["request_id"])
+
+        bytes0 = disagg_mod._HANDOFF_BYTES.value
+        kill_at = max(2, int(n_requests * 0.4))
+        dis = _drive_mixed(router, trace, kill_at=kill_at,
+                           on_kill=kill.dead.set)
+        summary = _disagg_summary(dis)
+        handoffs = max(1, router.disagg_handoffs)
+        summary["handoff_kb_per_request"] = round(
+            (disagg_mod._HANDOFF_BYTES.value - bytes0)
+            / 1024.0 / handoffs, 2)
+        summary["handoffs"] = router.disagg_handoffs
+        summary["reingests"] = router.disagg_reingests
+        summary["killed_after_n_requests"] = kill_at
+        out["disagg"] = summary
+
+        out["itl_p99_ratio"] = round(
+            out["disagg"]["itl_p99_ms"]
+            / out["monolithic"]["itl_p99_ms"], 3)
+        out["itl_p50_ratio"] = round(
+            out["disagg"]["itl_p50_ms"]
+            / out["monolithic"]["itl_p50_ms"], 3)
+        out["kill_failures"] = (out["disagg"]["failures"]
+                                + out["monolithic"]["failures"])
+        return out
+    finally:
+        global_config.kv_paged, global_config.kv_prefix_reuse = prev
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=32)
@@ -390,7 +656,44 @@ def main(argv=None) -> int:
                         "baseline")
     p.add_argument("--update-baseline", action="store_true",
                    help="rewrite matching baseline values from this run")
+    p.add_argument("--disagg", action="store_true",
+                   help="run ONLY the disaggregated prefill/decode "
+                        "scenario (own artifact serving_disagg.json)")
     args = p.parse_args(argv)
+
+    if args.disagg:
+        print("== disagg (1 prefill + 2 decode vs 3 monolithic, "
+              "decode kill mid-run) ==", flush=True)
+        dis = bench_disagg(args.requests, args.seed)
+        print(json.dumps(dis, indent=1), flush=True)
+        out_path = args.out if args.out != DEFAULT_OUT else \
+            os.path.join(REPO, "benchmark", "results",
+                         "serving_disagg.json")
+        results = {"n_requests": args.requests, "seed": args.seed,
+                   "disagg": dis}
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {out_path}")
+        if args.gate or args.update_baseline:
+            from benchmark import perf_gate
+            fresh = perf_gate.flatten_metrics({"serving": {"disagg": {
+                "itl_p99_ratio": dis["itl_p99_ratio"],
+                "kill_failures": dis["kill_failures"],
+                "reingests": dis["disagg"]["reingests"],
+                "handoff_kb_per_request":
+                    dis["disagg"]["handoff_kb_per_request"],
+                "ttft_p99_ms": dis["disagg"]["ttft_p99_ms"],
+            }}})
+            if args.update_baseline:
+                perf_gate._update(fresh, perf_gate.DEFAULT_BASELINE)
+                return 0
+            verdict = perf_gate.gate(fresh)
+            print(json.dumps(verdict, indent=1))
+            if not verdict["pass"]:
+                print("SERVING DISAGG GATE FAILED", file=sys.stderr)
+                return 1
+        return 0
 
     import tempfile
     print("== reuse (paged prefix reuse vs unpaged) ==", flush=True)
