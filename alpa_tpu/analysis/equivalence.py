@@ -477,6 +477,14 @@ def check_equiv(model, hooks: Optional[Sequence[Any]] = None,
                     arg_ax.append(a)
                 joined = frozenset().union(*arg_ax) if arg_ax \
                     else frozenset()
+                # Quantized gradient sync (ISSUE 19): a stage whose
+                # gradient accumulation runs through the stochastic-
+                # rounding codec computes the source jaxpr only up to
+                # the certified bound — the proof needs the QUANT
+                # axiom, admissible (like quantized RESHARDs) only
+                # under a clean numerics certificate.
+                if getattr(op, "grad_quant", None):
+                    joined = joined | frozenset({AXIOM_QUANT})
                 acc_in = set(acc.values())
                 contrib_args = tuple(t for i, t in enumerate(args)
                                      if i not in acc_in)

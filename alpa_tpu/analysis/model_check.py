@@ -912,11 +912,12 @@ def model_to_dict(model: PlanModel,
         "overlap_window": overlap_window,
         "slots": [dataclasses.asdict(sm)
                   for _s, sm in sorted(model.slots.items())],
-        # the ISSUE-15 "equiv" facts are omitted when absent so
-        # pre-existing committed fixtures round-trip byte-identically
+        # the ISSUE-15 "equiv" / ISSUE-19 "grad_quant" facts are omitted
+        # when absent so pre-existing committed fixtures round-trip
+        # byte-identically
         "ops": [{k: (list(v) if isinstance(v, tuple) else v)
                  for k, v in dataclasses.asdict(op).items()
-                 if not (k == "equiv" and v is None)}
+                 if not (k in ("equiv", "grad_quant") and v is None)}
                 for op in model.ops],
         "streams": [list(s) for s in model.streams],
         "deps": {str(i): sorted(v) for i, v in model.deps.items()},

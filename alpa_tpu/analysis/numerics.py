@@ -48,6 +48,14 @@ Finding taxonomy (:func:`severity_of`):
   accumulates below fp32.
 * ``numerics.quantized-reduction`` (note) — one per lossy collective,
   enumerating codec, edge, and the composed bound after the hop.
+* ``numerics.quantized-gradient`` (note, ISSUE 19) — one per RUN whose
+  gradient-provenance accumulation runs through the stochastic-rounding
+  gradient codec (``grad_quantize != off``): composes
+  ``ERROR_BOUND["grad_<mode>"]`` (the ``_rs`` two-hop variant for
+  reduce-scatter syncs) onto the flowing bound, amortized to a single
+  hop under error feedback and additive in the microbatch hop count
+  without it; ``numerics_error_budget`` gates the result exactly like
+  a resharding hop.
 
 Gated by ``global_config.verify_plans_numerics`` (``off | warn |
 error``, default ``warn``; env ``ALPA_TPU_VERIFY_NUMERICS``) —
@@ -84,6 +92,7 @@ _SEVERITY = {
     "numerics.budget-exceeded": "error",
     "numerics.bf16-accumulation": "warning",
     "numerics.quantized-reduction": "note",
+    "numerics.quantized-gradient": "note",
 }
 
 
@@ -215,6 +224,45 @@ def check_numerics(model, hooks: Optional[Sequence[Any]] = None,
                     f"partial sums lose mantissa before the final "
                     f"cast", op.idx))
             accum = str(prec.get("min_accum") or "")
+            # Quantized gradient sync (ISSUE 19): a RUN carrying a
+            # grad_quant fact whose donated inputs have gradient
+            # provenance is a quantized gradient accumulation/sync —
+            # compose the codec's stochastic-rounding bound.  With
+            # error feedback the residual carries untransmitted mass
+            # forward, so the cumulative bound over all accumulation
+            # hops amortizes to a single hop; without it the worst
+            # case is additive in the hop count.
+            gq = getattr(op, "grad_quant", None) or {}
+            if gq and in_prov == "gradient":
+                mode = str(gq.get("mode", "int8"))
+                bkey = f"grad_{mode}" + ("_rs" if gq.get("rs") else "")
+                per_hop = bounds.get(bkey, max(bounds.values()))
+                n_hops = 1 if gq.get("ef", True) else \
+                    max(1, int(gq.get("hops", 1)))
+                add = per_hop * n_hops
+                new_bound = in_bound + add
+                hop = f"{op.label or f'op{op.idx}'}:{bkey}"
+                in_bound = new_bound
+                in_hops = in_hops + (hop,)
+                lossy_edges[bkey] = lossy_edges.get(bkey, 0) + 1
+                findings.append(Finding(
+                    "numerics", "numerics.quantized-gradient",
+                    f"{op.label}: quantized gradient sync ({bkey}, "
+                    f"documented bound {per_hop:.6g} of blockmax x "
+                    f"{n_hops} hop(s)"
+                    + (", error-feedback amortized" if gq.get("ef", True)
+                       else "") +
+                    f"); composed bound after sync {new_bound:.6g}",
+                    op.idx))
+                if new_bound > budget:
+                    dsts = [s for s in op.writes if s not in budget_hit]
+                    budget_hit.update(dsts)
+                    findings.append(Finding(
+                        "numerics", "numerics.budget-exceeded",
+                        f"{op.label}: composed worst-case gradient "
+                        f"bound {new_bound:.6g} exceeds "
+                        f"numerics_error_budget {budget:.6g} after "
+                        f"quantized sync {hop}", op.idx))
             for pos, s in enumerate(op.writes):
                 declared = (op.out_avals[pos]
                             if pos < len(op.out_avals) else None)

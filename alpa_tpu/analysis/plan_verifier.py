@@ -98,8 +98,13 @@ ANALYSES = ("typing", "deadlock", "liveness", "structure",
 #: and slots/ops grow provenance/codec/precision facts; v5: the
 #: ISSUE-15 translation validation joins as the seventh analysis and
 #: RUN ops grow stage-decomposition ``equiv`` facts, so cached
-#: verdicts re-derive under the new proof obligations)
-ANALYSES_VERSION = 5
+#: verdicts re-derive under the new proof obligations; v6: the
+#: ISSUE-19 quantized gradient collectives — RUN ops carry
+#: ``grad_quant`` facts, the numerics analysis composes the gradient
+#: codec's stochastic-rounding bounds under the error-feedback
+#: amortization rule, and the equivalence prover admits quantized
+#: gradient hops only with a clean numerics certificate)
+ANALYSES_VERSION = 6
 
 _REG = _tmetrics.get_registry()
 _PEAK_BYTES = _REG.gauge(
@@ -197,6 +202,13 @@ class OpModel:
     # RUN stage-decomposition facts for the translation validation:
     # {"stage": sig, "mb": int, "donate": [pos...], "acc": {out: in}}
     equiv: Optional[Dict[str, Any]] = None
+    # RUN quantized-gradient facts (ISSUE 19), present only when
+    # global_config.grad_quantize != "off" at lowering time:
+    # {"mode": "int8"|"fp8", "ef": bool, "hops": int, "rs": bool} — the
+    # numerics analysis composes ERROR_BOUND[f"grad_{mode}"] onto
+    # gradient-provenance accumulations, amortized to one hop under
+    # error feedback
+    grad_quant: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -444,6 +456,7 @@ def build_model(instructions: Sequence[Any],
                 _aval_of(v)[:2] for v in getattr(ex, "outvars", ()))
             op.precision = r.get("precision")
             op.equiv = r.get("equiv")
+            op.grad_quant = r.get("grad_quant")
         elif kind == "RESHARD":
             op.edge = r.get("edge")
             op.cross = bool(r.get("cross", False))

@@ -166,6 +166,36 @@ class LogicalDeviceMesh:
         a, b, tie = self._ab("reduce_scatter", mesh_dim)
         return a + b * (n - 1) / n * num_bytes + tie
 
+    # -- quantized twins (ISSUE 19): gradient collectives through the
+    # blockwise codec.  Wire bytes shrink to 1 byte/element + one fp32
+    # scale per 256-element block (reshard_codec.wire_bytes); encode +
+    # decode each cost roughly one collective launch, charged as a
+    # fixed 2*alpha addend so tiny tensors never flip.
+
+    def _quantized_wire_bytes(self, num_bytes: float,
+                              itemsize: int = 4) -> float:
+        from alpa_tpu.mesh_profiling import quantized_wire_bytes
+        return quantized_wire_bytes(num_bytes, itemsize)
+
+    def all_reduce_cost_quantized(self, num_bytes: float, mesh_dim: int,
+                                  itemsize: int = 4) -> float:
+        n = self.shape[mesh_dim]
+        if n == 1:
+            return 0.0
+        a, b, tie = self._ab("all_reduce", mesh_dim)
+        qb = self._quantized_wire_bytes(num_bytes, itemsize)
+        return 3 * a + b * 2 * (n - 1) / n * qb + tie
+
+    def reduce_scatter_cost_quantized(self, num_bytes: float,
+                                      mesh_dim: int,
+                                      itemsize: int = 4) -> float:
+        n = self.shape[mesh_dim]
+        if n == 1:
+            return 0.0
+        a, b, tie = self._ab("reduce_scatter", mesh_dim)
+        qb = self._quantized_wire_bytes(num_bytes, itemsize)
+        return 3 * a + b * (n - 1) / n * qb + tie
+
     def all_to_all_cost(self, num_bytes: float, mesh_dim: int) -> float:
         n = self.shape[mesh_dim]
         if n == 1:

@@ -229,6 +229,27 @@ class GlobalConfig:
         # Minimum payload bytes before the transfer codec applies.
         self.reshard_quantize_min_bytes = int(os.environ.get(
             "ALPA_TPU_RESHARD_QUANTIZE_MIN_BYTES", "65536"))
+        # Quantized GRADIENT collectives (ISSUE 19; EQuARX-style):
+        # "off" | "int8" | "fp8".  Opt-in: the auto-sharding ILP prices
+        # quantized vs full-precision gradient all-reduce /
+        # reduce-scatter per tensor and the numerics certifier composes
+        # the codec's stochastic-rounding ERROR_BOUND into the
+        # end-to-end budget.  "off" produces byte-identical plans,
+        # fingerprints, and cache keys.
+        self.grad_quantize = os.environ.get(
+            "ALPA_TPU_GRAD_QUANTIZE", "off")
+        # Minimum gradient tensor bytes before the gradient codec
+        # applies; smaller tensors aren't bandwidth-bound and keep the
+        # full-precision collective.
+        self.grad_quantize_min_bytes = int(os.environ.get(
+            "ALPA_TPU_GRAD_QUANTIZE_MIN_BYTES", "65536"))
+        # Error feedback for quantized gradients: carry the
+        # quantization residual into the next step's quantization so
+        # cumulative error stays at the single-shot bound (the numerics
+        # analysis amortizes the bound accordingly).  On by default
+        # whenever grad_quantize is enabled.
+        self.grad_error_feedback = os.environ.get(
+            "ALPA_TPU_GRAD_ERROR_FEEDBACK", "on") != "off"
 
         # ---------- profile-guided replanning (ISSUE 12) ----------
         # Close the loop from measured step performance back into the

@@ -20,6 +20,7 @@ side of auto stage construction.  Two paths, like the reference:
 import dataclasses
 import json
 import logging
+import math
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -37,6 +38,23 @@ DEFAULT_SEC_PER_FLOP = 1.0 / 100e12
 
 COLLECTIVE_KINDS = ("all_reduce", "all_gather", "reduce_scatter",
                     "all_to_all")
+
+# Blockwise-codec wire accounting (ISSUE 19): elements per scaling
+# block, mirrored from pipeline_parallel/reshard_codec.BLOCK so the
+# cost model and the codec agree on wire bytes without importing jax at
+# module load.
+QUANT_BLOCK = 256
+
+
+def quantized_wire_bytes(num_bytes: float, itemsize: int = 4) -> float:
+    """Wire bytes a gradient collective moves under the blockwise codec:
+    1 byte per element plus one fp32 scale per 256-element block —
+    ``n + 4 * ceil(n / 256)`` for ``n = num_bytes / itemsize`` elements
+    (a ~3.94x cut for block-aligned fp32 payloads)."""
+    itemsize = max(1, int(itemsize))
+    elems = max(0.0, float(num_bytes)) / itemsize
+    nblocks = math.ceil(elems / QUANT_BLOCK) if elems else 0
+    return elems + 4.0 * nblocks
 
 # Version stamp written into saved profiling DBs (ISSUE 12 satellite):
 # load() validates it and warns on mismatch; stampless files are the

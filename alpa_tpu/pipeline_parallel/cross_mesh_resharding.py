@@ -812,9 +812,18 @@ def choose_strategy(shape, itemsize, src_sharding, dst_sharding
     if store is not None:
         src_key = _sharding_key(src_sharding)
         dst_key = _sharding_key(dst_sharding)
+        # Codec bucket (ISSUE 19): a quantized edge moves ~4x fewer
+        # bytes, so its measured samples must not re-price the
+        # full-precision signature.  Mirror the transfer factory's
+        # eligibility (fp32/bf16 payload over the min-bytes floor).
+        q_mode = getattr(global_config, "reshard_quantize", "off")
+        q_min = getattr(global_config, "reshard_quantize_min_bytes",
+                        65536)
+        codec = q_mode if (q_mode != "off" and itemsize in (2, 4) and
+                           nbytes >= q_min) else None
         for name in opts:
             sig = _calibration.wire_signature(shape, itemsize, src_key,
-                                              dst_key, name)
+                                              dst_key, name, codec=codec)
             # attach the analytic price this entry would supersede
             # (drift denominator) before consulting it
             store.set_modeled("reshard_wire", sig, costs[name] * 1e6)

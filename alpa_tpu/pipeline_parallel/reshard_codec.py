@@ -27,6 +27,28 @@ tests/pipeline_parallel/test_reshard_strategies.py):
 Wire accounting: an fp32 edge under int8 moves ``N + 4 * ceil(N/256)``
 bytes instead of ``4 * N`` — a ~3.94x reduction for block-aligned sizes
 (the ≥3.5x acceptance floor in benchmark/resharding_collectives.json).
+
+Gradient variant (ISSUE 19; same EQuARX lineage): the ``grad_*``
+entries of :data:`ERROR_BOUND` cover the quantized gradient-collective
+path used for DP/ZeRO gradient sync.  Two changes vs the activation
+codec make it safe on the training path:
+
+* **Stochastic rounding** (:func:`encode_stochastic`) — each element
+  rounds to a neighbouring grid point with probability proportional to
+  its distance, so ``E[decode(encode(x))] = x`` exactly and quantization
+  noise cannot bias the optimizer.  The price is a worst-case error of
+  one *full* step (``1/127`` of block max for int8, one e4m3 step for
+  fp8) instead of round-to-nearest's half step.
+* **Error feedback** (:func:`grad_compress`) — the residual
+  ``x - decode(encode(x))`` is carried into the next quantization, so
+  the *cumulative* multi-step error stays bounded by the single-shot
+  bound instead of growing with the step count
+  (:func:`grad_error_bound` encodes that amortization rule for the
+  numerics certifier).
+
+:func:`grad_reduce_scatter` composes quantize → partial-reduce →
+requantize for the ZeRO reduce-scatter path; the ``grad_*_rs`` bounds
+document both hops.
 """
 import logging
 from typing import Optional
@@ -53,6 +75,18 @@ BLOCK = 256
 ERROR_BOUND = {
     "int8": 1.0 / 254.0,    # scale/2 = amax_block/254
     "fp8": 0.07,            # e4m3 rounding, documented 7% of blockmax
+    # Gradient variants (ISSUE 19): stochastic rounding picks the
+    # neighbour *probabilistically* so the expectation is exact, which
+    # doubles the worst-case single-element step vs round-to-nearest —
+    # a full quantization step instead of half of one.
+    "grad_int8": 1.0 / 127.0,   # one full step = scale = amax_block/127
+    "grad_fp8": 0.08,           # full e4m3 step, 32/448 ≈ 7.14% + slack
+    # Two-hop reduce-scatter composition: each replica quantizes its
+    # contribution (hop 1), the partial sum is requantized for the
+    # scatter hop (hop 2).  First-order additive, same convention the
+    # numerics analysis uses for chained RESHARD hops.
+    "grad_int8_rs": 2.0 / 127.0,
+    "grad_fp8_rs": 0.16,
 }
 
 # dtypes the codec accepts; everything else passes through untouched
@@ -247,3 +281,191 @@ def maybe_quantized_transfer(aval, src_sharding, dst_sharding,
         logger.warning("quantized transfer setup failed; falling back",
                        exc_info=True)
         return None
+
+
+# --------------------------------------------------------------------------
+# Gradient codec (ISSUE 19): stochastic rounding + error feedback for
+# quantized gradient collectives in DP/ZeRO training.
+# --------------------------------------------------------------------------
+
+#: Codec modes the gradient path accepts (`global_config.grad_quantize`).
+GRAD_MODES = ("int8", "fp8")
+
+# Smallest *normal* fp32 the per-block scale is clamped to.  XLA CPU
+# flushes subnormals to zero (FTZ), so a subnormal ``amax / wire_max``
+# would read as 0 and the unclamped division would produce inf.  A
+# normal-range floor survives FTZ: blocks whose max magnitude is below
+# ``wire_max * _SCALE_FLOOR`` degrade from the relative ERROR_BOUND to
+# an *absolute* error of one floor step (~1.18e-38 — far below any
+# gradient signal), and all-zero blocks stay bit-exact.
+_SCALE_FLOOR = np.float32(1.1754944e-38)
+
+_GQ_TENSORS = _REG.counter(
+    "alpa_grad_quantized_tensors_total",
+    "Gradient tensors the plan routed through the quantized "
+    "gradient-collective codec",
+    labelnames=("codec",))
+_GQ_BYTES_SAVED = _REG.counter(
+    "alpa_grad_quantized_bytes_saved_total",
+    "Gradient-sync wire bytes saved by the quantized codec vs "
+    "full-precision collectives")
+_GQ_EF_NORM = _REG.gauge(
+    "alpa_grad_error_feedback_norm",
+    "L2 norm of the most recent per-replica error-feedback residual "
+    "carried into the next step's gradient quantization")
+
+
+def note_grad_quantized(codec: str, full_bytes: int,
+                        wire_nbytes: int) -> None:
+    """Record one gradient tensor routed through the codec (called at
+    plan time — the byte math is static, so counting happens where the
+    ILP makes the choice, not inside the jitted step)."""
+    _GQ_TENSORS.labels(codec).inc()
+    _GQ_BYTES_SAVED.inc(max(0, int(full_bytes) - int(wire_nbytes)))
+
+
+def note_error_feedback_norm(value: float) -> None:
+    """Export the residual-buffer L2 norm (host-side, set by the bench
+    and tests after pulling the residual off the device)."""
+    _GQ_EF_NORM.set(float(value))
+
+
+def encode_stochastic(x, mode: str, key):
+    """Blockwise quantization with *stochastic rounding*: same layout as
+    :func:`encode` (``(q, scales)``, one fp32 scale per 256-element
+    block) but each element rounds up with probability equal to its
+    fractional distance, so the expectation is exact —
+    ``E[decode(encode_stochastic(x))] = x``.
+
+    * ``int8`` — ``lo = floor(x/scale)``; round up when ``u < frac``.
+      Worst-case element error is one full step ``scale =
+      amax_block/127`` (``ERROR_BOUND["grad_int8"]``).
+    * ``fp8`` — rounds onto the exact ``float8_e4m3fn`` grid: step is
+      ``2^(floor(log2 |q|) - 3)`` (3 mantissa bits), ``2^-9`` in the
+      subnormal range below ``2^-6``.  Worst step at the top of the
+      range is ``32`` of ``448`` → ``ERROR_BOUND["grad_fp8"]``.
+    """
+    import jax
+    import jax.numpy as jnp
+    if mode not in GRAD_MODES:
+        raise ValueError(f"unknown gradient codec mode: {mode!r}")
+    n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 1
+    nb = -(-n // BLOCK)
+    flat = jnp.ravel(x).astype(jnp.float32)
+    flat = jnp.pad(flat, (0, nb * BLOCK - n))
+    blocks = flat.reshape(nb, BLOCK)
+    amax = jnp.max(jnp.abs(blocks), axis=1, keepdims=True)
+    scale = jnp.where(
+        amax > 0,
+        jnp.maximum(amax / _wire_max(mode), _SCALE_FLOOR),
+        1.0).astype(jnp.float32)
+    q = blocks / scale
+    u = jax.random.uniform(key, blocks.shape, dtype=jnp.float32)
+    if mode == "int8":
+        lo = jnp.floor(q)
+        q = lo + (u < (q - lo)).astype(jnp.float32)
+        q = jnp.clip(q, -127.0, 127.0)
+    else:
+        a = jnp.abs(q)
+        e = jnp.floor(jnp.log2(jnp.maximum(a, 2.0 ** -6)))
+        step = jnp.where(a < 2.0 ** -6, 2.0 ** -9, jnp.exp2(e - 3.0))
+        lo = jnp.floor(q / step) * step
+        q = lo + jnp.where(u < (q - lo) / step, step, 0.0)
+        q = jnp.clip(q, -_wire_max(mode), _wire_max(mode))
+    return q.astype(_wire_dtype(mode)), scale
+
+
+def grad_compress(g, mode: str, key, residual=None):
+    """One error-feedback quantization of a gradient tensor.
+
+    Adds the carried ``residual`` (what previous steps failed to
+    transmit), stochastically quantize-dequantizes through the wire
+    dtype, and returns ``(g_hat, new_residual)`` where ``new_residual =
+    (g + residual) - g_hat`` is carried into the *next* step's call.
+    With the residual threaded, the cumulative error of the transmitted
+    sum over any window stays bounded by the single-shot
+    ``ERROR_BOUND[f"grad_{mode}"]`` — the amortization rule
+    :func:`grad_error_bound` gives the numerics certifier.
+    """
+    import jax.numpy as jnp
+    x = g if residual is None else g + residual.astype(g.dtype)
+    q, scale = encode_stochastic(x, mode, key)
+    g_hat = decode(q, scale, tuple(x.shape), x.dtype, mode)
+    new_residual = (x.astype(jnp.float32) -
+                    g_hat.astype(jnp.float32)).astype(x.dtype)
+    return g_hat, new_residual
+
+
+def grad_reduce_scatter(grads, mode: str, key, residuals=None):
+    """Quantize → partial-reduce → requantize composition for the ZeRO
+    reduce-scatter path (emulated replica-by-replica, the same way the
+    repo's wire model emulates collectives).
+
+    Each replica's gradient goes through one :func:`grad_compress` hop
+    (its residual feeds back locally); the reducer averages the decoded
+    contributions and *requantizes* the partial sum for the scatter
+    hop.  Two stochastic hops total — the ``grad_*_rs``
+    :data:`ERROR_BOUND` entries document the composed bound.  Returns
+    ``(mean_gradient, new_residuals)``.
+    """
+    import jax
+    import jax.numpy as jnp
+    n = len(grads)
+    keys = jax.random.split(key, n + 1)
+    hats, new_res = [], []
+    for i, g in enumerate(grads):
+        r = None if residuals is None else residuals[i]
+        h, nr = grad_compress(g, mode, keys[i], r)
+        hats.append(h)
+        new_res.append(nr)
+    partial = hats[0].astype(jnp.float32)
+    for h in hats[1:]:
+        partial = partial + h.astype(jnp.float32)
+    partial = (partial / n).astype(grads[0].dtype)
+    q, scale = encode_stochastic(partial, mode, keys[n])
+    out = decode(q, scale, tuple(partial.shape), partial.dtype, mode)
+    return out, new_res
+
+
+def grad_error_bound(mode: str, reduce_scatter: bool = False,
+                     error_feedback: bool = True, hops: int = 1) -> float:
+    """Composed relative error bound for a quantized gradient sync.
+
+    ``reduce_scatter`` selects the two-hop ``grad_*_rs`` entry.  With
+    error feedback the residual carries untransmitted mass forward, so
+    the cumulative bound over any number of accumulation hops equals
+    the single-shot bound; without it the worst case is additive in
+    ``hops`` (one per microbatch quantization).
+    """
+    bkey = f"grad_{mode}" + ("_rs" if reduce_scatter else "")
+    per_hop = ERROR_BOUND[bkey]
+    if error_feedback:
+        return per_hop
+    return per_hop * max(1, int(hops))
+
+
+def grad_wire_bytes(shape, itemsize: int, mode: str) -> int:
+    """Wire bytes for one gradient tensor under the codec (same layout
+    as the activation codec: 1 byte/element + one fp32 scale per
+    block)."""
+    return wire_bytes(shape, itemsize, mode)
+
+
+def grad_eligible(shape, dtype, mode: str,
+                  min_bytes: Optional[int] = None) -> bool:
+    """Whether one gradient tensor may go through the gradient codec
+    under ``global_config.grad_quantize`` /
+    ``grad_quantize_min_bytes``."""
+    if mode not in GRAD_MODES:
+        return False
+    if mode == "fp8" and not have_fp8():
+        return False
+    if str(np.dtype(dtype)) not in _ELIGIBLE_DTYPES:
+        return False
+    n = int(np.prod(tuple(shape), dtype=np.int64)) if shape else 1
+    nbytes = n * np.dtype(dtype).itemsize
+    if min_bytes is None:
+        from alpa_tpu.global_env import global_config
+        min_bytes = getattr(global_config, "grad_quantize_min_bytes",
+                            65536)
+    return nbytes >= int(min_bytes)

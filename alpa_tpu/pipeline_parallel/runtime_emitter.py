@@ -1262,6 +1262,27 @@ def lower_to_register_file(
                 "donate": list(info["donate"]),
                 "acc": dict(info["acc"])}
 
+    # quantized gradient collectives (ISSUE 19): when the knob is on,
+    # every RUN record carries the codec facts; the numerics analysis
+    # composes the bound only where the stage actually donates
+    # gradient-provenance accumulators, so the tag alone never taints a
+    # forward stage.  None at grad_quantize=off — records (and
+    # therefore plan fingerprints) are byte-identical to main.
+    _gq_mode = getattr(global_config, "grad_quantize", "off")
+    _grad_tag = None
+    if _gq_mode != "off":
+        _mbs = {int(getattr(inst, "micro_batch", 0) or 0)
+                for inst in instructions
+                if inst.opcode == PipelineInstType.RUN and
+                getattr(inst, "micro_batch", None) is not None}
+        _grad_tag = {
+            "mode": _gq_mode,
+            "ef": bool(getattr(global_config, "grad_error_feedback",
+                               True)),
+            "hops": max(1, len(_mbs)),
+            "rs": False,
+        }
+
     for inst in instructions:
         if inst.opcode == PipelineInstType.RUN:
             by_opcode["RUN"] += 1
@@ -1298,6 +1319,7 @@ def lower_to_register_file(
                 "finfo": {"stage": inst.info, "mesh_id": inst.dst_mesh},
                 "precision": _precision_of(ex),
                 "equiv": _equiv_of(inst, ex),
+                "grad_quant": _grad_tag,
                 "idem": not donated,
                 "line": (f"RUN {inst.info} mb={inst.micro_batch} "
                          f"in={in_slots} out={out_slots} "

@@ -147,6 +147,28 @@ def rewrite_for_grad_accumulation(fun: Callable,
     compute_fn = jaxpr_as_fun(compute_cj)
     apply_fn = jaxpr_as_fun(apply_cj)
 
+    # Quantized gradient sync (ISSUE 19): when the knob is on, each
+    # microbatch's gradient contribution goes through the blockwise
+    # stochastic-rounding codec before accumulation — emulating the
+    # per-sync quantized collective — with the error-feedback residual
+    # threaded through the scan carry alongside the accumulators, so
+    # what one hop fails to transmit the next hop carries.  At the
+    # default ``grad_quantize=off`` the original body/scan is traced
+    # unchanged (byte-identical jaxpr and compiled HLO).
+    from alpa_tpu.global_env import global_config
+    gq_mode = getattr(global_config, "grad_quantize", "off")
+    q_set = set()
+    if gq_mode != "off":
+        from alpa_tpu.pipeline_parallel import reshard_codec as _codec
+        q_set = {
+            j for j, a in enumerate(acc_avals)
+            if _codec.grad_eligible(
+                tuple(a.shape), a.dtype, gq_mode,
+                getattr(global_config, "grad_quantize_min_bytes", 65536))
+        }
+    use_ef = bool(q_set) and getattr(global_config, "grad_error_feedback",
+                                     True)
+
     def grad_acc_fun(*full_args):
         assert len(full_args) == num_args
         # Reshape batch args to (num_micro_batches, micro, ...).
@@ -157,16 +179,46 @@ def rewrite_for_grad_accumulation(fun: Callable,
                 a.reshape((num_micro_batches, a.shape[0] // num_micro_batches)
                           + a.shape[1:]))
 
-        def body(acc, mb_slices):
-            args = list(full_args)
-            for i, s in zip(batch_list, mb_slices):
-                args[i] = s
-            vals = compute_fn(*args)
-            new_acc = [a + v for a, v in zip(acc, vals)]
-            return new_acc, None
+        if not q_set:
+            def body(acc, mb_slices):
+                args = list(full_args)
+                for i, s in zip(batch_list, mb_slices):
+                    args[i] = s
+                vals = compute_fn(*args)
+                new_acc = [a + v for a, v in zip(acc, vals)]
+                return new_acc, None
 
-        acc0 = [jnp.zeros(a.shape, a.dtype) for a in acc_avals]
-        acc, _ = lax.scan(body, acc0, stacked, length=num_micro_batches)
+            acc0 = [jnp.zeros(a.shape, a.dtype) for a in acc_avals]
+            acc, _ = lax.scan(body, acc0, stacked, length=num_micro_batches)
+        else:
+            from alpa_tpu.pipeline_parallel import reshard_codec as _codec
+
+            def body(carry, xs):
+                acc, res = carry
+                mb_slices, key = xs
+                args = list(full_args)
+                for i, s in zip(batch_list, mb_slices):
+                    args[i] = s
+                vals = compute_fn(*args)
+                new_acc, new_res = [], []
+                for j, (a, v) in enumerate(zip(acc, vals)):
+                    if j in q_set:
+                        kj = jax.random.fold_in(key, j)
+                        v_hat, r_new = _codec.grad_compress(
+                            v, gq_mode, kj, res[j] if use_ef else None)
+                        new_acc.append(a + v_hat)
+                        new_res.append(r_new if use_ef else res[j])
+                    else:
+                        new_acc.append(a + v)
+                        new_res.append(res[j])
+                return (new_acc, new_res), None
+
+            keys = jax.random.split(jax.random.PRNGKey(0),
+                                    num_micro_batches)
+            acc0 = [jnp.zeros(a.shape, a.dtype) for a in acc_avals]
+            res0 = [jnp.zeros(a.shape, a.dtype) for a in acc_avals]
+            (acc, _res), _ = lax.scan(body, (acc0, res0), (stacked, keys),
+                                      length=num_micro_batches)
         acc = [a / num_micro_batches for a in acc]
         return apply_fn(*full_args, *acc)
 

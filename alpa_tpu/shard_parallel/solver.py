@@ -55,6 +55,38 @@ def candidate_mesh_shapes(num_devices: int,
     return shapes
 
 
+def _grad_quantize_cache_token() -> Optional[str]:
+    """ILP cache-key token for the quantized-gradient knobs (ISSUE 19).
+    None at ``grad_quantize=off`` so default-mode keys stay
+    byte-identical with plans solved before this feature existed."""
+    mode = getattr(global_config, "grad_quantize", "off")
+    if mode == "off":
+        return None
+    return "gq:{}:{}:{}".format(
+        mode,
+        int(getattr(global_config, "grad_quantize_min_bytes", 65536)),
+        1 if getattr(global_config, "grad_error_feedback", True) else 0)
+
+
+def _note_grad_quantized_choices(graph, choice) -> None:
+    """Export plan-time metrics for gradient tensors the ILP routed
+    through the codec (the byte math is static, so counting happens
+    here rather than inside the jitted step)."""
+    for node, s in zip(graph.nodes, choice):
+        if node.kind != "invar":
+            continue
+        st = node.strategies[s]
+        codec = getattr(st, "codec", None)
+        if not codec:
+            continue
+        from alpa_tpu.pipeline_parallel import reshard_codec as _codec
+        shape = tuple(getattr(node.aval, "shape", ()))
+        itemsize = int(np.dtype(node.aval.dtype).itemsize)
+        full = int(np.prod(shape, dtype=np.int64) if shape else 1) * itemsize
+        _codec.note_grad_quantized(
+            codec, full, _codec.grad_wire_bytes(shape, itemsize, codec))
+
+
 def plan_auto_sharding(fun: Callable,
                        in_avals: Sequence[Any],
                        in_paths: Sequence[str],
@@ -78,6 +110,7 @@ def plan_auto_sharding(fun: Callable,
         cache = get_compile_cache()
         from alpa_tpu.telemetry.calibration import calibration_cache_token
         cal_tok = calibration_cache_token()
+        gq_tok = _grad_quantize_cache_token()
         key = cache.make_key("ilp", [
             "plan_auto_sharding",
             str(closed_jaxpr),
@@ -87,8 +120,11 @@ def plan_auto_sharding(fun: Callable,
             repr((physical_mesh.num_hosts, physical_mesh.num_devices)),
             option,
             # calibration fingerprint (ISSUE 12): absent when
-            # replan_mode=off so off-mode keys stay byte-identical
-        ] + ([cal_tok] if cal_tok else []))
+            # replan_mode=off so off-mode keys stay byte-identical;
+            # grad-quantize token (ISSUE 19): same contract — absent at
+            # grad_quantize=off
+        ] + ([cal_tok] if cal_tok else [])
+          + ([gq_tok] if gq_tok else []))
         entry = cache.get("ilp", key)
         if entry is not None:
             with _ttrace.span("ilp-cache-replay", "compile",
@@ -193,6 +229,7 @@ def _assemble_plan(closed_jaxpr, in_avals, in_paths, batch_flat_idx, option,
                    shape, logical_mesh, graph, choice, return_graph):
     """Turn a solved (graph, choice) into the plan_auto_sharding result
     tuple.  Shared by the fresh-solve path and the cache-replay path."""
+    _note_grad_quantized_choices(graph, choice)
     axis_names = MESH_AXIS_NAMES[:len(shape)]
     jax_mesh = logical_mesh.get_jax_mesh(axis_names)
 

@@ -115,6 +115,12 @@ class Strategy:
     # batch over out-channel conv sharding, the reference's data-parallel
     # bias); excluded from comm accounting and solution_cost
     tie_bias: float = 0.0
+    # gradient-collective codec realizing comm_cost (ISSUE 19): None =
+    # full precision; "int8"/"fp8" = the blockwise stochastic-rounding
+    # codec (reshard_codec), priced by the *_cost_quantized twins.  Only
+    # ever set when global_config.grad_quantize != "off", so default
+    # plans stay byte-identical.
+    codec: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -944,6 +950,39 @@ def build_strategy_graph(closed_jaxpr: ClosedJaxpr,
                         f"zero{str(s)}", s, max(0.0, charge - credit),
                         mem_bytes=sharded_bytes(aval, s, mesh_shape),
                         comm_kind="reduce_scatter"))
+                    # Quantized gradient reduce-scatter twin (ISSUE 19):
+                    # same layout, but the gradient sync runs through
+                    # the blockwise stochastic-rounding codec — the
+                    # credit prices the *quantized* reduce-scatter, so
+                    # the ILP flips per tensor exactly when the wire
+                    # saving beats the encode/decode charge.  Only
+                    # enumerated when the knob is on and the leaf is
+                    # eligible (dtype + grad_quantize_min_bytes), so
+                    # grad_quantize=off plans are byte-identical.
+                    from alpa_tpu.global_env import global_config
+                    gq_mode = getattr(global_config, "grad_quantize",
+                                      "off")
+                    if gq_mode != "off":
+                        from alpa_tpu.pipeline_parallel import (
+                            reshard_codec as _codec)
+                        if _codec.grad_eligible(
+                                aval.shape, aval.dtype, gq_mode,
+                                getattr(global_config,
+                                        "grad_quantize_min_bytes",
+                                        65536)):
+                            itemsize = int(aval.dtype.itemsize)
+                            credit_q = sum(
+                                logical_mesh.all_reduce_cost(nbytes, a) -
+                                logical_mesh.reduce_scatter_cost_quantized(
+                                    nbytes, a, itemsize)
+                                for a in axes)
+                            strategies.append(Strategy(
+                                f"zero{str(s)}_q{gq_mode}", s,
+                                max(0.0, charge - credit_q),
+                                mem_bytes=sharded_bytes(
+                                    aval, s, mesh_shape),
+                                comm_kind="reduce_scatter",
+                                codec=gq_mode))
                 else:
                     # Replication keeps the full leaf resident; carry the
                     # tie penalty so equal-cost solutions prefer the

@@ -68,8 +68,13 @@ __all__ = [
 ]
 
 # Bump to invalidate persisted entries on layout changes; entries with a
-# different stamp are skipped (warned), never mis-parsed.
-CALIBRATION_FORMAT_VERSION = 1
+# different stamp are skipped (warned), never mis-parsed.  v2 (ISSUE 19)
+# split the reshard_wire signature on the transfer codec (``|codec=``):
+# before that a quantized edge's measured samples silently re-priced the
+# full-precision signature.  v1 entries migrate on load (wire entries
+# get ``|codec=none`` appended; everything else just re-stamps) with a
+# format warning, like the PROF_DB legacy path.
+CALIBRATION_FORMAT_VERSION = 2
 
 # Bounded reservoir: the most recent N samples back the median/p90 so
 # one entry file stays O(1) and old regimes age out.
@@ -106,13 +111,16 @@ def edge_signature(src: str, dst: str) -> str:
 
 
 def wire_signature(shape, itemsize, src_key: str, dst_key: str,
-                   strategy: str) -> str:
+                   strategy: str, codec: Optional[str] = None) -> str:
     """Planner-consult edge signature: the PR 7 reshard-edge identity
     (shape, itemsize, device-id-free sharding keys) plus the executed
     strategy — only the strategy that actually ran gets its cost
-    overridden; the alternatives stay analytic."""
+    overridden; the alternatives stay analytic.  ``codec`` (ISSUE 19)
+    keeps quantized and full-precision prices in separate buckets: a
+    quantized edge moves ~4x fewer bytes, so its measured samples must
+    never re-price the lossless signature."""
     return (f"wire:{tuple(shape)}x{int(itemsize)}|"
-            f"{src_key}->{dst_key}|{strategy}")
+            f"{src_key}->{dst_key}|{strategy}|codec={codec or 'none'}")
 
 
 def collective_signature(kind: str, nbytes: float) -> str:
@@ -237,7 +245,32 @@ class CalibrationStore:
             try:
                 with open(path, encoding="utf-8") as f:
                     data = json.load(f)
-                if int(data.get("format", 0)) != CALIBRATION_FORMAT_VERSION:
+                fmt = int(data.get("format", 0))
+                if fmt == 1:
+                    # v1 -> v2 migration (ISSUE 19): wire signatures
+                    # gained a ``|codec=`` suffix; pre-split samples
+                    # were necessarily full-precision, so they land in
+                    # the ``codec=none`` bucket.  Other kinds are
+                    # layout-identical and just re-stamp.
+                    sig = str(data.get("signature", ""))
+                    if (sig.startswith("wire:") and
+                            "|codec=" not in sig):
+                        data["signature"] = sig + "|codec=none"
+                    logger.warning(
+                        "calibration entry %s has format 1 (want %s); "
+                        "migrating and re-stamping", path,
+                        CALIBRATION_FORMAT_VERSION)
+                    entry = CalibrationEntry.from_json(data)
+                    self._entries[(entry.kind, entry.signature)] = entry
+                    new_path = self._path_of(entry)
+                    self._save_entry(entry)
+                    if new_path and new_path != path:
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
+                    continue
+                if fmt != CALIBRATION_FORMAT_VERSION:
                     logger.warning(
                         "calibration entry %s has format %s (want %s); "
                         "skipping", path, data.get("format"),

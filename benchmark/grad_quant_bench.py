@@ -1,0 +1,248 @@
+"""Quantized gradient collectives benchmark (ISSUE 19): fp32 vs
+int8 vs fp8 gradient quantization on the committed GPT fixture.
+
+Three training runs from identical init (Adam, 12 steps):
+
+- ``fp32``   — the reference loss curve, gradients untouched;
+- ``int8`` / ``fp8`` — every eligible gradient leaf passes through
+  :func:`reshard_codec.grad_compress` (blockwise stochastic rounding)
+  each step, with the per-tensor error-feedback residual carried
+  across steps exactly as the grad-accum scan carries it across
+  micro-batches.
+
+Reported per quantized run: the gradient wire-byte reduction (byte
+math: ``4N`` fp32 bytes vs ``N + 4·⌈N/256⌉`` quantized), the full
+loss curve, and the max per-step loss delta vs the fp32 reference.
+A deterministic section compiles the 2-stage pipeshard MLP fixture
+under ``grad_quantize=int8`` and reports the seven-analysis verdict's
+composed end-to-end gradient bound (``numerics.max_error_bound``) —
+the number the launch gate compares against ``numerics_error_budget``.
+
+Usage:  python benchmark/grad_quant_bench.py [--out F] [--gate]
+                                             [--steps N]
+
+``--gate`` checks the wire-byte ratio, the loss deltas, and the
+certified bound against ``benchmark/results/perf_gate_baseline.json``
+(``gradquant.*`` entries) and exits nonzero on regression.  Writes
+JSON next to the other suite results
+(benchmark/results/grad_quant.json).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_OUT = os.path.join(REPO, "benchmark", "results",
+                           "grad_quant.json")
+
+#: leaves below this are too small to quantize in the bench fixture
+#: (the production default is 64 KiB; the fixture model is tiny)
+MIN_BYTES = 1024
+
+
+def _gpt_train_state(batch_size=4):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax.training import train_state
+
+    from alpa_tpu.model.gpt_model import GPTConfig, GPTModel
+
+    config = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                       num_heads=4, seq_len=32)
+    model = GPTModel(config)
+    rngkey = jax.random.PRNGKey(0)
+    input_ids = jax.random.randint(rngkey, (batch_size, config.seq_len),
+                                   0, config.vocab_size, jnp.int32)
+    params = model.init(rngkey, input_ids)
+    state = train_state.TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.adam(learning_rate=1e-3))
+    batch = {"input_ids": input_ids,
+             "labels": jnp.roll(input_ids, -1, axis=1)}
+    return state, batch
+
+
+def _wire_bytes(params, mode):
+    """(full_bytes, wire_bytes) over the eligible gradient leaves."""
+    import jax
+    import numpy as np
+
+    from alpa_tpu.pipeline_parallel import reshard_codec as codec
+
+    full = wire = 0.0
+    for leaf in jax.tree_util.tree_leaves(params):
+        nbytes = float(np.prod(leaf.shape)) * leaf.dtype.itemsize \
+            if leaf.shape else leaf.dtype.itemsize
+        if codec.grad_eligible(tuple(leaf.shape), leaf.dtype, mode,
+                               min_bytes=MIN_BYTES):
+            full += nbytes
+            wire += codec.grad_wire_bytes(tuple(leaf.shape),
+                                          leaf.dtype.itemsize, mode)
+    return full, wire
+
+
+def train_run(mode, n_steps):
+    """One training run; mode 'fp32' = reference, else grad codec."""
+    import jax
+    import jax.numpy as jnp
+
+    from alpa_tpu.model.model_util import gpt_lm_loss
+    from alpa_tpu.pipeline_parallel import reshard_codec as codec
+
+    state, batch = _gpt_train_state()
+
+    @jax.jit
+    def grads_of(params):
+        def loss_fn(p):
+            return gpt_lm_loss(state.apply_fn, p, batch)
+        return jax.value_and_grad(loss_fn)(params)
+
+    def quantize(grads, residuals, key):
+        flat, treedef = jax.tree_util.tree_flatten(grads)
+        keys = jax.random.split(key, len(flat))
+        new_flat, new_res = [], []
+        for i, (g, r) in enumerate(zip(flat, residuals)):
+            if codec.grad_eligible(tuple(g.shape), g.dtype, mode,
+                                   min_bytes=MIN_BYTES):
+                g_hat, r_new = codec.grad_compress(g, mode, keys[i],
+                                                   residual=r)
+                new_flat.append(g_hat)
+                new_res.append(r_new)
+            else:
+                new_flat.append(g)
+                new_res.append(r)
+        return jax.tree_util.tree_unflatten(treedef, new_flat), new_res
+
+    residuals = [None] * len(
+        jax.tree_util.tree_leaves(state.params))
+    losses = []
+    for step in range(n_steps):
+        loss, grads = grads_of(state.params)
+        if mode != "fp32":
+            key = jax.random.fold_in(jax.random.PRNGKey(19), step)
+            grads, residuals = quantize(grads, residuals, key)
+        state = state.apply_gradients(grads=grads)
+        losses.append(float(loss))
+
+    out = {"mode": mode, "losses": [round(x, 6) for x in losses],
+           "final_loss": round(losses[-1], 6)}
+    if mode != "fp32":
+        full, wire = _wire_bytes(state.params, mode)
+        out["grad_bytes_full"] = full
+        out["grad_bytes_wire"] = wire
+        out["wire_ratio"] = round(full / max(wire, 1.0), 4)
+        res_norm = float(jnp.sqrt(sum(
+            jnp.sum(jnp.square(r)) for r in residuals
+            if r is not None)))
+        out["error_feedback_norm"] = round(res_norm, 6)
+        codec.note_error_feedback_norm(res_norm)
+    return out
+
+
+def bench_pipeshard_certified() -> dict:
+    """Deterministic: the 2-stage pipeshard MLP fixture compiled under
+    ``grad_quantize=int8`` — the seven-analysis verdict composes the
+    end-to-end gradient bound the launch gate enforces."""
+    from alpa_tpu.global_env import global_config
+    from alpa_tpu.parallel_method import PipeshardParallel
+    from alpa_tpu.pipeline_parallel.layer_construction import (
+        ManualLayerOption)
+    from alpa_tpu.pipeline_parallel.stage_construction import (
+        UniformStageOption)
+    from alpa_tpu.shard_parallel.auto_sharding import AutoShardingOption
+    from alpa_tpu.testing import (create_mlp_train_state_and_batch,
+                                  get_mlp_train_step)
+
+    global_config.grad_quantize = "int8"
+    global_config.grad_quantize_min_bytes = 0
+    try:
+        method = PipeshardParallel(
+            num_micro_batches=2,
+            layer_option=ManualLayerOption(),
+            stage_option=UniformStageOption(num_stages=2),
+            default_auto_sharding_option=AutoShardingOption(
+                zero_stage="0"))
+        state, batch = create_mlp_train_state_and_batch(
+            batch_size=64, num_layers=4, manual_pipeline_layer=True)
+        pstep = get_mlp_train_step(method, use_value_and_grad=True)
+        state, _ = pstep(state, batch)
+        v = pstep.get_last_executable().get_plan_verdict()
+    finally:
+        global_config.grad_quantize = "off"
+        global_config.grad_quantize_min_bytes = 65536
+    num = v.stats.get("numerics") or {}
+    return {
+        "ok": bool(v.ok),
+        "certified_bound": num.get("max_error_bound", 0.0),
+        "budget": num.get("budget"),
+        "lossy_edges": num.get("lossy_edges", {}),
+    }
+
+
+def run(n_steps: int) -> dict:
+    from alpa_tpu.pipeline_parallel.reshard_codec import have_fp8
+
+    modes = ["fp32", "int8"] + (["fp8"] if have_fp8() else [])
+    runs = {m: train_run(m, n_steps) for m in modes}
+    certified = bench_pipeshard_certified()
+
+    gate_metrics = {}
+    ref = runs["fp32"]["losses"]
+    for m in modes[1:]:
+        deltas = [abs(a - b) for a, b in zip(runs[m]["losses"], ref)]
+        runs[m]["loss_max_delta"] = round(max(deltas), 6)
+        gate_metrics[f"gradquant.loss_delta_{m}"] = max(deltas)
+        gate_metrics[f"gradquant.wire_ratio_{m}"] = \
+            runs[m]["wire_ratio"]
+    gate_metrics["gradquant.certified_bound"] = \
+        certified["certified_bound"]
+
+    return {"runs": runs, "certified": certified,
+            "n_steps": n_steps,
+            "gate_metrics": {k: round(v, 6)
+                             for k, v in gate_metrics.items()}}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--out", default=DEFAULT_OUT)
+    parser.add_argument("--steps", type=int, default=12)
+    parser.add_argument("--gate", action="store_true",
+                        help="check wire-byte ratio, loss deltas and "
+                             "the certified bound against the "
+                             "committed perf-gate baseline")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        # the pipeshard fixture wants 2 stages x a dp submesh
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") +
+            " --xla_force_host_platform_device_count=8")
+    import alpa_tpu
+    alpa_tpu.init("local")
+
+    result = run(args.steps)
+    result["timestamp"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+    print(f"\nwrote {args.out}")
+
+    if args.gate:
+        from benchmark.perf_gate import gate
+        verdict = gate(result["gate_metrics"])
+        print(json.dumps(verdict, indent=1))
+        if not verdict["pass"]:
+            sys.exit("GRAD QUANT BENCH PERF GATE FAILED")
+
+
+if __name__ == "__main__":
+    main()
